@@ -1,0 +1,93 @@
+"""Single-chip serving benchmark.
+
+Measures steady-state decode throughput of the flagship dense model through
+the REAL engine path (continuous batching, paged KV, on-device sampling) on
+whatever accelerator JAX exposes (one TPU chip under the driver).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": r}
+
+Baseline: 2,200 output tok/s/GPU — the reference's wide-EP H200 headline
+(BASELINE.md; README.md:20).  Not apples-to-apples yet (that number is
+DeepSeek-R1 on 32 chips; this is a 1B dense model on one chip) but it is the
+bar the driver tracks; the wide-EP bench replaces this as the MoE path
+matures.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+from llm_d_tpu.engine.request import Request
+from llm_d_tpu.ops.sampling import SamplingParams
+
+BASELINE_TOK_S_PER_CHIP = 2200.0
+
+
+def main() -> None:
+    n_seqs = 64
+    prompt_len = 128
+    decode_steps = 128
+
+    cfg = EngineConfig(
+        model="llama3-1b",
+        block_size=32,
+        num_blocks=2048,
+        max_num_seqs=n_seqs,
+        max_num_batched_tokens=8192,
+        num_scheduler_steps=32,
+    )
+    engine = EngineCore(cfg)
+
+    reqs = [
+        Request(
+            request_id=f"bench-{i}",
+            prompt_token_ids=[(7 * i + j) % 32000 + 1 for j in range(prompt_len)],
+            sampling=SamplingParams(temperature=0.0, max_tokens=decode_steps + 1,
+                                    ignore_eos=True),
+        )
+        for i in range(n_seqs)
+    ]
+    for r in reqs:
+        engine.add_request(r)
+
+    # Prefill (also warms up compile for the prefill bucket).
+    t0 = time.perf_counter()
+    while any(r.num_computed_tokens < r.num_prompt_tokens for r in reqs):
+        engine.step()
+    t_prefill = time.perf_counter() - t0
+
+    # One decode step to compile the decode bucket before timing.
+    engine.step()
+
+    tokens_before = sum(len(r.output_token_ids) for r in reqs)
+    t1 = time.perf_counter()
+    while engine.has_work():
+        engine.step()
+    t_decode = time.perf_counter() - t1
+    tokens_after = sum(len(r.output_token_ids) for r in reqs)
+
+    decode_tok_s = (tokens_after - tokens_before) / t_decode
+    ttft = t_prefill / 1.0
+
+    result = {
+        "metric": "decode_output_tok_s_per_chip_llama1b_bs64",
+        "value": round(decode_tok_s, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(decode_tok_s / BASELINE_TOK_S_PER_CHIP, 3),
+        "extras": {
+            "backend": jax.default_backend(),
+            "prefill_s_64x128": round(t_prefill, 3),
+            "decode_steps": decode_steps,
+            "batch_size": n_seqs,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from llm_d_tpu.engine.request import Request, RequestOutput, RequestState
+from llm_d_tpu.engine.engine import EngineCore, EngineConfig
+
+__all__ = ["Request", "RequestOutput", "RequestState", "EngineCore", "EngineConfig"]

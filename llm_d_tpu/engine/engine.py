@@ -1,0 +1,504 @@
+"""EngineCore: the JAX serving engine (the reference's vLLM equivalent).
+
+Owns the device state (params + paged KV cache), turns scheduler output into
+static-shape batches (bucketed so XLA compiles a bounded set of programs),
+runs one fused forward+sample program per step, and advances request state.
+
+TPU-first choices:
+  - one jitted step handles mixed prefill+decode (ragged batch) — big
+    matmuls for the MXU even when decodes dominate;
+  - token/sequence dims bucket to powers of two: no data-dependent shapes;
+  - KV cache buffers are donated each step (in-place paged updates);
+  - sampling happens on device, only sampled ids travel host-ward.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llm_d_tpu.engine.kv_cache import KVCacheManager
+from llm_d_tpu.engine.request import Request, RequestOutput, RequestState
+from llm_d_tpu.engine.scheduler import Scheduler, SchedulerOutput
+from llm_d_tpu.models import llama
+from llm_d_tpu.models.config import ModelConfig, get_config
+from llm_d_tpu.ops import sampling as sampling_ops
+from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
+from llm_d_tpu.parallel.sharding import logical_to_sharding, shard_pytree
+from llm_d_tpu.utils.metrics import EngineMetrics
+
+logger = logging.getLogger(__name__)
+
+
+def _next_bucket(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "tiny"                      # preset name
+    model_config: Optional[ModelConfig] = None
+    block_size: int = 32
+    num_blocks: int = 256                    # KV blocks incl. null block 0
+    max_num_seqs: int = 64
+    max_num_batched_tokens: int = 1024
+    enable_prefix_caching: bool = True
+    attn_backend: str = "auto"
+    mesh: Optional[MeshConfig] = None        # None = single device
+    seed: int = 0
+    min_token_bucket: int = 16
+    min_seq_bucket: int = 8
+    # Fused multi-step decode: when a step is pure decode, run this many
+    # engine steps in one device program with on-device token feedback —
+    # amortizes host<->device transfer latency (the reference's
+    # --async-scheduling analogue; decode.yaml:77,97).
+    num_scheduler_steps: int = 1
+
+    def resolve_model(self) -> ModelConfig:
+        return self.model_config or get_config(self.model)
+
+
+class EngineCore:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params: Optional[Any] = None,
+        metrics: Optional[EngineMetrics] = None,
+    ) -> None:
+        self.config = config
+        self.model_config = config.resolve_model()
+        c = self.model_config
+
+        self.mesh = make_mesh(config.mesh) if config.mesh else make_mesh(
+            MeshConfig(), [jax.devices()[0]])
+        self.kv_manager = KVCacheManager(
+            config.num_blocks, config.block_size,
+            enable_prefix_caching=config.enable_prefix_caching)
+        self.scheduler = Scheduler(
+            self.kv_manager,
+            max_num_seqs=config.max_num_seqs,
+            max_num_batched_tokens=config.max_num_batched_tokens,
+            max_model_len=c.max_model_len)
+        self.metrics = metrics or EngineMetrics(c.name)
+
+        # --- device state ---
+        rules = llama.sharding_rules(c)
+        if params is None:
+            params = llama.init_params(c, jax.random.PRNGKey(config.seed))
+        shardings = logical_to_sharding(rules, params, self.mesh)
+        self.params = shard_pytree(params, shardings)
+
+        num_slots = config.num_blocks * config.block_size
+        # Folded layout [L, slots, KVH*D]: 128-lane-aligned page DMAs and
+        # contiguous scatter rows (see ops/attention.py docstring).
+        kv_shape = (c.num_layers, num_slots, c.num_kv_heads * c.head_dim_)
+        kv_sharding = {
+            k: NamedSharding(self.mesh, spec)
+            for k, spec in llama.kv_cache_spec().items()}
+        self.kv_cache = {
+            k: jax.device_put(jnp.zeros(kv_shape, jnp.bfloat16), kv_sharding[k])
+            for k in ("k", "v")}
+        self._replicated = NamedSharding(self.mesh, P())
+
+        self.max_blocks_per_seq = -(-c.max_model_len // config.block_size)
+        self._rng = jax.random.PRNGKey(config.seed)
+        self._step_count = 0
+        # PD producer: finished prefills whose blocks stay pinned until the
+        # decode engine pulls them (reference contract: README.tpu.md:182-189).
+        self.pinned_transfers: Dict[str, Request] = {}
+        # Optional KV connector (set by the server / PD wiring).
+        self.kv_connector = None
+        self.eos_token_id: Optional[int] = None
+        self._last_evictions = 0
+        self._last_preemptions = 0
+
+        self._step_fn = self._build_step_fn()
+        self._multistep_fn = (
+            self._build_multistep_fn(config.num_scheduler_steps)
+            if config.num_scheduler_steps > 1 else None)
+
+    # ---------- jitted step ----------
+
+    def _build_step_fn(self):
+        c = self.model_config
+        block_size = self.config.block_size
+        backend = self.config.attn_backend
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step_fn(params, kv_cache, batch, rng):
+            hidden, kv_cache = llama.forward(
+                params, kv_cache, batch, c, block_size, backend)
+            logits = llama.compute_logits(params, hidden, c)
+            ids = sampling_ops.sample(
+                logits, batch["temperature"], batch["top_k"], batch["top_p"], rng)
+            logprobs = sampling_ops.compute_logprobs(logits, ids)
+            return ids, logprobs, kv_cache
+
+        return step_fn
+
+    def _build_multistep_fn(self, K: int):
+        """K fused decode iterations: sampled ids feed the next iteration on
+        device; only the final [K, S] id matrix crosses the tunnel."""
+        c = self.model_config
+        block_size = self.config.block_size
+        backend = self.config.attn_backend
+
+        @functools.partial(jax.jit, static_argnums=(), donate_argnums=(1,))
+        def multistep_fn(params, kv_cache, mbatch, rng):
+            S = mbatch["last_ids"].shape[0]
+            bt = mbatch["block_tables"]
+
+            def one_iter(carry, key):
+                kv_cache, last_ids, pos0 = carry
+                # Decode batch: T == S, one token per sequence.
+                slot = (jnp.take_along_axis(
+                    bt, (pos0 // block_size)[:, None], axis=1)[:, 0]
+                    * block_size + pos0 % block_size)
+                batch = dict(
+                    token_ids=last_ids,
+                    positions=pos0,
+                    token_seq_ids=jnp.arange(S, dtype=jnp.int32),
+                    token_qpos=jnp.zeros(S, jnp.int32),
+                    slot_mapping=jnp.where(
+                        mbatch["active"], slot, pos0 % block_size),
+                    block_tables=bt,
+                    seq_lens=jnp.where(mbatch["active"], pos0 + 1, 0),
+                    sample_idx=jnp.arange(S, dtype=jnp.int32),
+                    qtok_idx=jnp.arange(S, dtype=jnp.int32)[:, None],
+                )
+                hidden, kv_cache = llama.forward(
+                    params, kv_cache, batch, c, block_size, backend)
+                logits = llama.compute_logits(params, hidden, c)
+                ids = sampling_ops.sample(
+                    logits, mbatch["temperature"], mbatch["top_k"],
+                    mbatch["top_p"], key)
+                ids = jnp.where(mbatch["active"], ids, 0)
+                return (kv_cache, ids, pos0 + 1), ids
+
+            keys = jax.random.split(rng, K)
+            (kv_cache, _, _), ids_ks = jax.lax.scan(
+                one_iter, (kv_cache, mbatch["last_ids"],
+                           mbatch["pos0"]), keys)
+            return ids_ks, kv_cache   # [K, S]
+
+        return multistep_fn
+
+    def _try_multistep(self, sched: SchedulerOutput) -> Optional[int]:
+        """If this is a pure-decode round eligible for fusion, pre-allocate
+        K tokens per request and return K; else None."""
+        K = self.config.num_scheduler_steps
+        if self._multistep_fn is None or not sched.scheduled:
+            return None
+        for sr in sched.scheduled:
+            req = sr.request
+            if (sr.num_new_tokens != 1
+                    or req.num_computed_tokens != req.num_tokens - 1
+                    or req.do_remote_decode
+                    or req.sampling.logprobs):
+                return None
+            if req.num_tokens + K >= self.model_config.max_model_len:
+                return None
+        # Pre-allocate blocks to cover K new tokens for every request.
+        allocated = []
+        for sr in sched.scheduled:
+            req = sr.request
+            ok = self.kv_manager.allocate(req, req.num_computed_tokens + K)
+            if ok is None:
+                return None   # fall back to single-step (blocks stay; freed on finish)
+            allocated.append(ok)
+        return K
+
+    def _run_multistep(self, sched: SchedulerOutput, K: int) -> List[RequestOutput]:
+        cfg = self.config
+        bs = cfg.block_size
+        S_real = len(sched.scheduled)
+        S = _next_bucket(S_real, min(cfg.min_seq_bucket, cfg.max_num_seqs),
+                         cfg.max_num_seqs)
+        B = self.max_blocks_per_seq
+
+        last_ids = np.zeros(S, np.int32)
+        pos0 = np.zeros(S, np.int32)
+        block_tables = np.zeros((S, B), np.int32)
+        active = np.zeros(S, bool)
+        temperature = np.zeros(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        top_p = np.ones(S, np.float32)
+        for s, sr in enumerate(sched.scheduled):
+            req = sr.request
+            last_ids[s] = req.all_token_ids[req.num_computed_tokens]
+            pos0[s] = req.num_computed_tokens
+            block_tables[s, :len(req.block_ids)] = req.block_ids
+            active[s] = True
+            temperature[s] = req.sampling.temperature
+            top_k[s] = req.sampling.top_k
+            top_p[s] = req.sampling.top_p
+
+        mbatch = jax.device_put(dict(
+            last_ids=jnp.asarray(last_ids), pos0=jnp.asarray(pos0),
+            block_tables=jnp.asarray(block_tables),
+            active=jnp.asarray(active),
+            temperature=jnp.asarray(temperature),
+            top_k=jnp.asarray(top_k), top_p=jnp.asarray(top_p)),
+            self._replicated)
+        self._rng, step_key = jax.random.split(self._rng)
+        ids_ks, self.kv_cache = self._multistep_fn(
+            self.params, self.kv_cache, mbatch, step_key)
+        ids_ks = np.asarray(jax.device_get(ids_ks))   # [K, S]
+        self._step_count += K
+
+        outputs: List[RequestOutput] = []
+        now = time.monotonic()
+        for s, sr in enumerate(sched.scheduled):
+            req = sr.request
+            new_tokens: List[int] = []
+            finish = None
+            for k in range(K):
+                token = int(ids_ks[k, s])
+                req.num_computed_tokens += 1
+                req.output_token_ids.append(token)
+                new_tokens.append(token)
+                finish = self._check_stop(req, token)
+                if finish is not None:
+                    break
+            # Tokens past a stop are discarded; their KV writes live in
+            # already-allocated blocks and are freed with the request.
+            self.metrics.generation_tokens.inc(len(new_tokens))
+            if req.last_token_time is not None:
+                self.metrics.inter_token_latency.observe(
+                    (now - req.last_token_time) / max(1, len(new_tokens)))
+            req.last_token_time = now
+            self.kv_manager.cache_full_blocks(req)
+            outputs.append(RequestOutput(
+                req.request_id, new_tokens, finish is not None,
+                finish_reason=finish))
+            if finish is not None:
+                self.scheduler.finish(req, RequestState(finish))
+                self.metrics.request_success.labels(
+                    model_name=self.metrics.model_name,
+                    finished_reason=finish).inc()
+                self.metrics.e2e_request_latency.observe(now - req.arrival_time)
+        self._update_queue_metrics()
+        return outputs
+
+    # ---------- public API ----------
+
+    def add_request(self, request: Request) -> None:
+        if self.kv_connector is not None and request.kv_transfer_params:
+            # PD consumer: pull remote KV before the request becomes schedulable.
+            self.kv_connector.start_load_kv(self, request)
+            return
+        self.scheduler.add_request(request)
+
+    def abort_request(self, request_id: str) -> None:
+        self.scheduler.abort_request(request_id)
+        self.pinned_transfers.pop(request_id, None)
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    def release_pinned(self, request_id: str) -> None:
+        """Producer side: transfer complete, free the pinned prefill blocks."""
+        req = self.pinned_transfers.pop(request_id, None)
+        if req is not None:
+            self.kv_manager.free(req)
+
+    # ---------- batch building ----------
+
+    def _build_batch(self, out: SchedulerOutput) -> Tuple[Dict[str, jax.Array], List]:
+        cfg = self.config
+        bs = cfg.block_size
+        S_real = len(out.scheduled)
+        T_real = out.total_tokens
+        T = _next_bucket(T_real, cfg.min_token_bucket, cfg.max_num_batched_tokens)
+        S = _next_bucket(S_real, min(cfg.min_seq_bucket, cfg.max_num_seqs),
+                         cfg.max_num_seqs)
+        B = self.max_blocks_per_seq
+
+        # Per-seq query-slot bucket: 1 on pure-decode steps, else pow2.
+        max_q = max((sr.num_new_tokens for sr in out.scheduled), default=1)
+        Q = 1 if max_q == 1 else _next_bucket(
+            max_q, cfg.min_token_bucket, cfg.max_num_batched_tokens)
+
+        token_ids = np.zeros(T, np.int32)
+        positions = np.zeros(T, np.int32)
+        token_seq_ids = np.zeros(T, np.int32)
+        token_qpos = np.zeros(T, np.int32)
+        slot_mapping = np.zeros(T, np.int32)   # block 0 = trash for padding
+        block_tables = np.zeros((S, B), np.int32)
+        seq_lens = np.zeros(S, np.int32)
+        sample_idx = np.zeros(S, np.int32)
+        qtok_idx = np.full((S, Q), T, np.int32)  # T = padded-q sentinel row
+        temperature = np.zeros(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        top_p = np.ones(S, np.float32)
+
+        t = 0
+        for s, sr in enumerate(out.scheduled):
+            req, n = sr.request, sr.num_new_tokens
+            start = req.num_computed_tokens
+            toks = req.all_token_ids[start:start + n]
+            token_ids[t:t + n] = toks
+            positions[t:t + n] = np.arange(start, start + n)
+            token_seq_ids[t:t + n] = s
+            for j in range(n):
+                pos = start + j
+                blk = req.block_ids[pos // bs]
+                slot_mapping[t + j] = blk * bs + pos % bs
+            token_qpos[t:t + n] = np.arange(n)
+            qtok_idx[s, :n] = np.arange(t, t + n)
+            nb = len(req.block_ids)
+            block_tables[s, :nb] = req.block_ids
+            seq_lens[s] = start + n
+            sample_idx[s] = t + n - 1
+            sp = req.sampling
+            temperature[s] = sp.temperature
+            top_k[s] = sp.top_k
+            top_p[s] = sp.top_p
+            t += n
+
+        batch_np = dict(
+            token_ids=token_ids, positions=positions,
+            token_seq_ids=token_seq_ids, token_qpos=token_qpos,
+            slot_mapping=slot_mapping, block_tables=block_tables,
+            seq_lens=seq_lens, sample_idx=sample_idx, qtok_idx=qtok_idx,
+            temperature=temperature, top_k=top_k, top_p=top_p)
+        batch = jax.device_put(batch_np, self._replicated)
+        return batch, out.scheduled
+
+    # ---------- step ----------
+
+    def step(self) -> List[RequestOutput]:
+        outputs: List[RequestOutput] = []
+        sched = self.scheduler.schedule()
+        for req in sched.preempted:      # oversized requests finished by scheduler
+            outputs.append(RequestOutput(
+                req.request_id, [], True, finish_reason=req.state.value))
+        if sched.empty:
+            self._update_queue_metrics()
+            return outputs
+
+        K = self._try_multistep(sched)
+        if K is not None:
+            outputs.extend(self._run_multistep(sched, K))
+            return outputs
+
+        batch, scheduled = self._build_batch(sched)
+        self._rng, step_key = jax.random.split(self._rng)
+        ids, logprobs, self.kv_cache = self._step_fn(
+            self.params, self.kv_cache, batch, step_key)
+        ids = np.asarray(jax.device_get(ids))
+        logprobs = np.asarray(jax.device_get(logprobs))
+        self._step_count += 1
+
+        now = time.monotonic()
+        for s, sr in enumerate(scheduled):
+            req, n = sr.request, sr.num_new_tokens
+            req.num_computed_tokens += n
+            produced_token = req.num_computed_tokens == req.num_tokens
+            self.kv_manager.cache_full_blocks(req)
+            if not produced_token:
+                continue                  # mid-prefill chunk: no sampling yet
+            if req.num_computed_tokens <= req.num_prompt_tokens:
+                # Prefill just completed.
+                self.metrics.prompt_tokens.inc(req.num_prompt_tokens)
+                if req.num_cached_prompt_tokens:
+                    self.metrics.prefix_cache_hits.inc(req.num_cached_prompt_tokens)
+                self.metrics.prefix_cache_queries.inc(req.num_prompt_tokens)
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                    self.metrics.time_to_first_token.observe(
+                        now - req.arrival_time)
+                if req.do_remote_decode:
+                    # PD producer: stop here, pin blocks, publish transfer params.
+                    outputs.append(self._finish_remote_prefill(req, int(ids[s])))
+                    continue
+            else:
+                if req.last_token_time is not None:
+                    self.metrics.inter_token_latency.observe(
+                        now - req.last_token_time)
+            req.last_token_time = now
+
+            token = int(ids[s])
+            req.output_token_ids.append(token)
+            self.metrics.generation_tokens.inc()
+            finish = self._check_stop(req, token)
+            out = RequestOutput(
+                req.request_id, [token], finish is not None,
+                finish_reason=finish,
+                logprobs=[float(logprobs[s])] if req.sampling.logprobs else None)
+            outputs.append(out)
+            if finish is not None:
+                self.scheduler.finish(req, RequestState(finish))
+                self.metrics.request_success.labels(
+                    model_name=self.metrics.model_name,
+                    finished_reason=finish).inc()
+                self.metrics.e2e_request_latency.observe(now - req.arrival_time)
+
+        self._update_queue_metrics()
+        return outputs
+
+    def _finish_remote_prefill(self, req: Request, first_token: int) -> RequestOutput:
+        req.state = RequestState.FINISHED_REMOTE_PREFILL
+        self.scheduler.running.remove(req)
+        self.pinned_transfers[req.request_id] = req
+        params: Dict[str, Any] = {
+            "remote_block_ids": list(req.block_ids),
+            "remote_host": getattr(self.kv_connector, "host", "localhost"),
+            "remote_port": getattr(self.kv_connector, "port", 0),
+            "uuid": req.request_id,
+            "first_token": first_token,
+        }
+        req.kv_transfer_params = params
+        return RequestOutput(
+            req.request_id, [first_token], True,
+            finish_reason=RequestState.FINISHED_REMOTE_PREFILL.value,
+            kv_transfer_params=params)
+
+    def _check_stop(self, req: Request, token: int) -> Optional[str]:
+        sp = req.sampling
+        if not sp.ignore_eos and self.eos_token_id is not None \
+                and token == self.eos_token_id \
+                and len(req.output_token_ids) >= sp.min_tokens:
+            return RequestState.FINISHED_STOPPED.value
+        if len(req.output_token_ids) >= sp.max_tokens:
+            return RequestState.FINISHED_LENGTH.value
+        if req.num_tokens >= self.model_config.max_model_len:
+            return RequestState.FINISHED_LENGTH.value
+        return None
+
+    def _update_queue_metrics(self) -> None:
+        self.metrics.num_requests_waiting.set(self.scheduler.num_waiting)
+        self.metrics.num_requests_running.set(self.scheduler.num_running)
+        self.metrics.kv_cache_usage_perc.set(self.kv_manager.usage)
+        if self.kv_manager.eviction_count > self._last_evictions:
+            self.metrics.kv_cache_evictions.inc(
+                self.kv_manager.eviction_count - self._last_evictions)
+            self._last_evictions = self.kv_manager.eviction_count
+        if self.scheduler.num_preemptions > self._last_preemptions:
+            self.metrics.preemptions.inc(
+                self.scheduler.num_preemptions - self._last_preemptions)
+            self._last_preemptions = self.scheduler.num_preemptions
+
+    # ---------- convenience (tests / bench) ----------
+
+    def generate(self, requests: List[Request], max_steps: int = 10000
+                 ) -> Dict[str, List[int]]:
+        """Run requests to completion synchronously; returns output ids."""
+        for r in requests:
+            self.add_request(r)
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            self.step()
+        return {r.request_id: list(r.output_token_ids) for r in requests}

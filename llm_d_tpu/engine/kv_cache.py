@@ -1,0 +1,207 @@
+"""Paged KV-cache block management: allocator, prefix cache, eviction.
+
+Host-side bookkeeping for the device-resident paged cache (the device arrays
+live in the engine; this module deals only in block ids).  Design follows
+vLLM's prefix-caching allocator semantics — full blocks are content-hashed
+(chain scheme, ``llm_d_tpu.utils.hashing``) and kept after free in an LRU
+evictor so later requests with a shared prefix reuse them — because the
+scheduler-side prefix scorers (reference: gaie values, SURVEY.md §2.4) are
+calibrated against exactly this behavior.
+
+Block 0 is reserved as the null/trash block (padding writes, null table
+entries) and is never allocated.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from llm_d_tpu.engine.request import Request
+from llm_d_tpu.utils.hashing import hash_block
+
+# Event callbacks for the KV-event stream and tiered offload
+# (block_hash bytes, block_id) -> None
+BlockEvent = Callable[[bytes, int], None]
+
+
+class KVCacheManager:
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        enable_prefix_caching: bool = True,
+        hash_seed: str = "42",
+    ) -> None:
+        assert num_blocks >= 2
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self.hash_seed = hash_seed
+
+        # Blocks 1..num_blocks-1 are allocatable.
+        self._free: collections.deque[int] = collections.deque(range(1, num_blocks))
+        self._ref: Dict[int, int] = {}                   # block -> refcount
+        self._hash_of: Dict[int, bytes] = {}             # block -> content hash
+        self._cached: Dict[bytes, int] = {}              # hash -> block
+        # Free-but-cached blocks in LRU order (oldest first).
+        self._evictor: "collections.OrderedDict[int, None]" = collections.OrderedDict()
+        # Per-request chain of block hashes (computed lazily).
+        self._req_hashes: Dict[str, List[bytes]] = {}
+
+        self.on_block_stored: List[BlockEvent] = []      # KV events / offload
+        self.on_block_removed: List[BlockEvent] = []
+        self.eviction_count = 0
+
+    # ---------- introspection ----------
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free) + len(self._evictor)
+
+    @property
+    def usage(self) -> float:
+        usable = self.num_blocks - 1
+        return 1.0 - self.num_free_blocks / usable if usable else 0.0
+
+    # ---------- prefix cache ----------
+
+    def request_block_hashes(self, request: Request) -> List[bytes]:
+        """Chain hashes of every full block of the request's tokens."""
+        hashes = self._req_hashes.setdefault(request.request_id, [])
+        tokens = request.all_token_ids
+        n_full = len(tokens) // self.block_size
+        parent = hashes[-1] if hashes else None
+        for i in range(len(hashes), n_full):
+            chunk = tokens[i * self.block_size:(i + 1) * self.block_size]
+            parent = hash_block(parent, chunk, self.hash_seed)
+            hashes.append(parent)
+        return hashes[:n_full]
+
+    def find_cached_prefix(self, request: Request) -> Tuple[List[int], int]:
+        """Longest cached block-prefix for this request.
+
+        Returns (block_ids, num_cached_tokens). Does NOT take refs yet —
+        call ``allocate`` with these as ``reuse_blocks``.
+        """
+        if not self.enable_prefix_caching:
+            return [], 0
+        blocks: List[int] = []
+        for h in self.request_block_hashes(request):
+            b = self._cached.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+        # Never mark the whole prompt computed: the final token must be
+        # (re)computed to produce logits for sampling.
+        max_cacheable = (request.num_prompt_tokens - 1) // self.block_size
+        blocks = blocks[:max_cacheable + 1]
+        n = len(blocks) * self.block_size
+        if n >= request.num_prompt_tokens:
+            blocks = blocks[:max_cacheable]
+            n = len(blocks) * self.block_size
+        return blocks, n
+
+    # ---------- allocation ----------
+
+    def _take_free_block(self) -> Optional[int]:
+        while self._free:
+            b = self._free.popleft()
+            if b not in self._evictor:      # plain free block
+                return b
+        if self._evictor:                   # evict LRU cached block
+            b, _ = self._evictor.popitem(last=False)
+            h = self._hash_of.pop(b, None)
+            if h is not None and self._cached.get(h) == b:
+                del self._cached[h]
+                self.eviction_count += 1
+                for cb in self.on_block_removed:
+                    cb(h, b)
+            return b
+        return None
+
+    def can_allocate(self, n: int) -> bool:
+        return self.num_free_blocks >= n
+
+    def allocate(self, request: Request, num_tokens_after: int,
+                 reuse_blocks: Sequence[int] = ()) -> Optional[List[int]]:
+        """Grow the request's block list to cover ``num_tokens_after`` tokens.
+
+        ``reuse_blocks`` are prefix-cache hits to adopt (only valid when the
+        request currently holds no blocks). Returns newly attached block ids
+        (reused + fresh), or None if not enough free blocks (caller preempts).
+        """
+        needed_blocks = -(-num_tokens_after // self.block_size)
+        new_needed = needed_blocks - len(request.block_ids)
+        if new_needed <= 0:
+            return []
+        attach: List[int] = []
+        if reuse_blocks:
+            assert not request.block_ids
+            attach.extend(reuse_blocks)
+            new_needed -= len(reuse_blocks)
+        if new_needed > 0 and len(self._free) + len(self._evictor) - sum(
+                1 for b in attach if b in self._evictor) < new_needed:
+            return None
+        # Take refs on reused blocks (possibly resurrecting from evictor).
+        for b in attach:
+            if b in self._evictor:
+                del self._evictor[b]
+            self._ref[b] = self._ref.get(b, 0) + 1
+        for _ in range(max(0, new_needed)):
+            b = self._take_free_block()
+            if b is None:       # raced with evictor bookkeeping; roll back
+                for bb in attach:
+                    self._release(bb)
+                return None
+            self._ref[b] = 1
+            attach.append(b)
+        request.block_ids.extend(attach)
+        return attach
+
+    def _release(self, b: int) -> None:
+        self._ref[b] -= 1
+        if self._ref[b] == 0:
+            del self._ref[b]
+            if self.enable_prefix_caching and b in self._hash_of:
+                self._evictor[b] = None     # keep cached, evict LRU later
+            else:
+                self._free.append(b)
+
+    def free(self, request: Request) -> None:
+        for b in reversed(request.block_ids):
+            self._release(b)
+        request.block_ids = []
+        self._req_hashes.pop(request.request_id, None)
+
+    def uncache_block(self, block_id: int) -> None:
+        """Drop a block's cache entry (used by offload tier on invalidation)."""
+        h = self._hash_of.pop(block_id, None)
+        if h is not None and self._cached.get(h) == block_id:
+            del self._cached[h]
+        if block_id in self._evictor:
+            del self._evictor[block_id]
+            self._free.append(block_id)
+
+    # ---------- post-step caching ----------
+
+    def cache_full_blocks(self, request: Request) -> None:
+        """Register content hashes for the request's now-full blocks."""
+        if not self.enable_prefix_caching:
+            return
+        hashes = self.request_block_hashes(request)
+        n_full_computed = request.num_computed_tokens // self.block_size
+        for i in range(min(n_full_computed, len(hashes), len(request.block_ids))):
+            b = request.block_ids[i]
+            if b in self._hash_of:
+                continue
+            h = hashes[i]
+            if h in self._cached:
+                continue        # another block already canonical for this hash
+            self._hash_of[b] = h
+            self._cached[h] = b
+            for cb in self.on_block_stored:
+                cb(h, b)
+
+    def lookup_hash(self, h: bytes) -> Optional[int]:
+        return self._cached.get(h)

@@ -1,0 +1,77 @@
+"""Request lifecycle objects shared by engine, server, and connectors."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any, Dict, List, Optional
+
+from llm_d_tpu.ops.sampling import SamplingParams
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED_STOPPED = "stop"          # hit stop token / stop string
+    FINISHED_LENGTH = "length"         # hit max_tokens / max_model_len
+    FINISHED_ABORTED = "abort"
+    # PD: prefill done on a producer engine, KV ready for remote pull
+    # (reference contract: README.tpu.md:182-189 kv_transfer_params).
+    FINISHED_REMOTE_PREFILL = "remote_prefill"
+
+    @property
+    def finished(self) -> bool:
+        return self in (RequestState.FINISHED_STOPPED,
+                        RequestState.FINISHED_LENGTH,
+                        RequestState.FINISHED_ABORTED,
+                        RequestState.FINISHED_REMOTE_PREFILL)
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_token_ids: List[int]
+    sampling: SamplingParams
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+    priority: int = 0
+
+    state: RequestState = RequestState.WAITING
+    output_token_ids: List[int] = dataclasses.field(default_factory=list)
+    # How many tokens of (prompt + output) have KV computed in the cache.
+    num_computed_tokens: int = 0
+    block_ids: List[int] = dataclasses.field(default_factory=list)
+    num_cached_prompt_tokens: int = 0      # prefix-cache hits (metrics/scoring)
+    num_preemptions: int = 0
+    first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
+
+    # --- PD disaggregation ---
+    # kv_role=producer engines stop after prefill and publish these;
+    # kv_role=consumer engines receive them and pull KV before decode.
+    kv_transfer_params: Optional[Dict[str, Any]] = None
+    do_remote_prefill: bool = False    # consumer side: pull KV before decode
+    do_remote_decode: bool = False     # producer side: stop after prefill
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return self.num_prompt_tokens + len(self.output_token_ids)
+
+    @property
+    def all_token_ids(self) -> List[int]:
+        return self.prompt_token_ids + self.output_token_ids
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    new_token_ids: List[int]
+    finished: bool
+    finish_reason: Optional[str] = None
+    kv_transfer_params: Optional[Dict[str, Any]] = None
+    logprobs: Optional[List[float]] = None

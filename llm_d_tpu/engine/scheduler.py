@@ -1,0 +1,181 @@
+"""Continuous-batching scheduler with chunked prefill and preemption.
+
+One scheduler invocation composes a mixed prefill+decode step under a token
+budget — the engine-side half of what the reference gets from vLLM's
+scheduler (continuous batching, chunked prefill, recompute-preemption).
+Unified steps (prefills and decodes in one batch) keep the TPU busy with
+large matmuls while decode latency stays bounded by the token budget.
+
+Scheduling policy: running requests first (decode steps starve last),
+then waiting requests FIFO by (priority, arrival).  On block exhaustion the
+most recently added running request is preempted and recomputed later
+(metric: ``vllm:num_preemptions_total``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from llm_d_tpu.engine.kv_cache import KVCacheManager
+from llm_d_tpu.engine.request import Request, RequestState
+
+
+@dataclasses.dataclass
+class ScheduledRequest:
+    request: Request
+    num_new_tokens: int           # tokens computed this step
+    is_first_schedule: bool = False
+
+
+@dataclasses.dataclass
+class SchedulerOutput:
+    scheduled: List[ScheduledRequest]
+    preempted: List[Request]
+    total_tokens: int
+
+    @property
+    def empty(self) -> bool:
+        return not self.scheduled
+
+
+class Scheduler:
+    def __init__(
+        self,
+        kv: KVCacheManager,
+        max_num_seqs: int = 64,
+        max_num_batched_tokens: int = 1024,
+        max_model_len: int = 32000,
+    ) -> None:
+        self.kv = kv
+        self.max_num_seqs = max_num_seqs
+        self.max_num_batched_tokens = max_num_batched_tokens
+        self.max_model_len = max_model_len
+        self.waiting: collections.deque[Request] = collections.deque()
+        self.running: List[Request] = []
+        self.num_preemptions = 0
+
+    # ---------- queue ops ----------
+
+    def add_request(self, request: Request) -> None:
+        request.state = RequestState.WAITING
+        self.waiting.append(request)
+
+    def abort_request(self, request_id: str) -> Optional[Request]:
+        for q in (self.waiting, self.running):
+            for r in list(q):
+                if r.request_id == request_id:
+                    q.remove(r)
+                    r.state = RequestState.FINISHED_ABORTED
+                    self.kv.free(r)
+                    return r
+        return None
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ---------- core ----------
+
+    def _preempt_for(self, needy: Request, preempted_now: set) -> bool:
+        """Preempt the most recent running request other than ``needy``."""
+        for victim in reversed(self.running):
+            if victim is needy:
+                continue
+            self.running.remove(victim)
+            self.kv.free(victim)
+            victim.num_computed_tokens = 0
+            victim.num_preemptions += 1
+            victim.state = RequestState.PREEMPTED
+            self.waiting.appendleft(victim)
+            preempted_now.add(victim.request_id)
+            self.num_preemptions += 1
+            return True
+        return False
+
+    def schedule(self) -> SchedulerOutput:
+        scheduled: List[ScheduledRequest] = []
+        preempted: List[Request] = []
+        budget = self.max_num_batched_tokens
+        # Requests preempted during this pass are not re-admitted in the same
+        # step: re-admission would recreate the memory pressure that forced
+        # the preemption (thrash).
+        preempted_now: set = set()
+
+        # 1. Running requests (decodes and in-flight chunked prefills).
+        for req in list(self.running):
+            if budget <= 0:
+                break
+            if req.request_id in preempted_now:
+                continue        # evicted by an earlier request in this pass
+            remaining = req.num_tokens - req.num_computed_tokens
+            if remaining <= 0:
+                remaining = 1       # decode: compute the next token's KV
+            n = min(remaining, budget)
+            while True:
+                ok = self.kv.allocate(req, req.num_computed_tokens + n)
+                if ok is not None:
+                    break
+                if not self._preempt_for(req, preempted_now):
+                    n = 0           # cannot run this request at all this step
+                    break
+            if n <= 0:
+                continue
+            budget -= n
+            scheduled.append(ScheduledRequest(req, n))
+
+        # 2. Waiting requests, FIFO within priority
+        # (lower priority value = more important, matching InferenceObjective).
+        pending = sorted(self.waiting, key=lambda r: (r.priority, r.arrival_time))
+        for req in pending:
+            if budget <= 0 or len(self.running) >= self.max_num_seqs:
+                break
+            if req.request_id in preempted_now:
+                continue
+            if req.num_tokens >= self.max_model_len:
+                # Oversized prompt: refuse by finishing with length.
+                self.waiting.remove(req)
+                req.state = RequestState.FINISHED_LENGTH
+                preempted.append(req)
+                continue
+            first = req.num_computed_tokens == 0 and not req.block_ids
+            reuse: List[int] = []
+            if first:
+                reuse, n_cached = self.kv.find_cached_prefix(req)
+                if req.do_remote_prefill:
+                    # PD consumer: KV arrives via the connector; only the
+                    # last prompt token is computed locally.
+                    reuse, n_cached = [], 0
+                req.num_computed_tokens = n_cached
+                req.num_cached_prompt_tokens = n_cached
+            remaining = req.num_tokens - req.num_computed_tokens
+            n = min(remaining, budget)
+            if n <= 0:
+                continue
+            ok = self.kv.allocate(req, req.num_computed_tokens + n, reuse)
+            if ok is None:
+                req.num_computed_tokens = 0
+                break               # head-of-line: don't skip ahead of FIFO
+            self.waiting.remove(req)
+            self.running.append(req)
+            req.state = RequestState.RUNNING
+            budget -= n
+            scheduled.append(ScheduledRequest(req, n, is_first_schedule=first))
+
+        return SchedulerOutput(
+            scheduled=scheduled, preempted=preempted,
+            total_tokens=sum(s.num_new_tokens for s in scheduled))
+
+    def finish(self, request: Request, state: RequestState) -> None:
+        request.state = state
+        if request in self.running:
+            self.running.remove(request)
+        self.kv.free(request)

@@ -1,0 +1,3 @@
+from llm_d_tpu.models.config import ModelConfig, PRESETS, get_config
+
+__all__ = ["ModelConfig", "PRESETS", "get_config"]

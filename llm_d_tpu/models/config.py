@@ -1,0 +1,113 @@
+"""Model configurations and presets.
+
+One config type covers the dense (Llama/Qwen) and MoE (Mixtral/DeepSeek
+-style) families; ``num_experts == 0`` means dense.  Presets mirror the
+models the reference's well-lit paths deploy: Qwen3-0.6B
+(inference-scheduling), Llama-3.3-70B (pd-disaggregation), DeepSeek-R1
+(wide-ep-lws), Qwen3-32B (tiered-prefix-cache), Mixtral-8x22B
+(predicted-latency) — reference: SURVEY.md §2.1, BASELINE.json configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "custom"
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: Optional[int] = None          # default hidden/heads
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False            # Qwen2: True
+    qk_norm: bool = False                   # Qwen3: True
+    max_model_len: int = 32000              # reference: ms-pd/values.yaml:41-42
+    dtype: str = "bfloat16"
+    # --- MoE (0 experts = dense) ---
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    num_shared_experts: int = 0             # DeepSeek shared expert(s)
+    first_dense_layers: int = 0             # DeepSeek: first k layers dense
+    moe_renormalize: bool = True
+    n_group: int = 0                        # DeepSeek group-limited routing (0=off)
+    topk_group: int = 0
+    routed_scaling_factor: float = 1.0
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def jax_dtype(self):
+        return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                "float16": jnp.float16}[self.dtype]
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+
+# ---- Presets (architecture dims from the public model cards) ----
+
+PRESETS = {
+    # Tiny configs for tests / CI (CPU-friendly).
+    "tiny": ModelConfig(
+        name="tiny", vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, rope_theta=10000.0,
+        max_model_len=512),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, rope_theta=10000.0,
+        max_model_len=512, num_experts=8, num_experts_per_tok=2,
+        moe_intermediate_size=96, num_shared_experts=1, first_dense_layers=1),
+    # inference-scheduling default model (reference: ms-inference-scheduling values).
+    "qwen3-0.6b": ModelConfig(
+        name="qwen3-0.6b", vocab_size=151936, hidden_size=1024,
+        intermediate_size=3072, num_layers=28, num_heads=16, num_kv_heads=8,
+        head_dim=128, rope_theta=1000000.0, qk_norm=True,
+        tie_word_embeddings=True, max_model_len=32768),
+    "llama3-8b": ModelConfig(
+        name="llama3-8b", vocab_size=128256, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        rope_theta=500000.0, max_model_len=32000),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b", vocab_size=128256, hidden_size=8192,
+        intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+        rope_theta=500000.0, max_model_len=32000),
+    # Single-chip bench model (fits one v5e's HBM in bf16).
+    "llama3-1b": ModelConfig(
+        name="llama3-1b", vocab_size=128256, hidden_size=2048,
+        intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+        head_dim=64, rope_theta=500000.0, max_model_len=8192),
+    "mixtral-8x22b": ModelConfig(
+        name="mixtral-8x22b", vocab_size=32768, hidden_size=6144,
+        intermediate_size=16384, num_layers=56, num_heads=48, num_kv_heads=8,
+        rope_theta=1000000.0, max_model_len=32000,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=16384),
+    # DeepSeek-V3/R1-class MoE (MHA dims simplified: GQA stand-in for MLA,
+    # MLA-proper is tracked as a follow-up kernel).
+    "deepseek-v3": ModelConfig(
+        name="deepseek-v3", vocab_size=129280, hidden_size=7168,
+        intermediate_size=18432, num_layers=61, num_heads=128, num_kv_heads=128,
+        head_dim=128, rope_theta=10000.0, max_model_len=32000,
+        num_experts=256, num_experts_per_tok=8, moe_intermediate_size=2048,
+        num_shared_experts=1, first_dense_layers=3, n_group=8, topk_group=4,
+        routed_scaling_factor=2.5),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset '{name}' (have {sorted(PRESETS)})")
+    return PRESETS[name]

@@ -1,0 +1,167 @@
+"""Dense decoder-only transformer (Llama / Qwen2 / Qwen3 families).
+
+Functional forward over a plain parameter pytree with layers *stacked* on a
+leading axis and iterated with ``lax.scan`` — one traced layer body instead
+of L inlined copies keeps XLA compile time flat in depth (important under
+continuous batching where several batch buckets each compile).
+
+This is the model half of the vLLM-equivalent engine (reference:
+docker/Dockerfile.cuda:61-63 pins the fork of vLLM this replaces).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from llm_d_tpu.models.config import ModelConfig
+from llm_d_tpu.ops import layers as L
+from llm_d_tpu.ops.attention import attention_with_kv_update
+
+Params = Dict[str, Any]
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    """Random-init parameters (tests / benchmarks); HF checkpoints load via
+    ``llm_d_tpu.models.loader``."""
+    c = config
+    dh = c.head_dim_
+    dt = c.jax_dtype
+    k = iter(jax.random.split(key, 16))
+
+    def w(shape, kk):
+        return (jax.random.normal(kk, shape, jnp.float32)
+                * (shape[0] ** -0.5)).astype(dt)
+
+    Lc = c.num_layers
+
+    def stacked(shape, kk):
+        return (jax.random.normal(kk, (Lc, *shape), jnp.float32)
+                * (shape[0] ** -0.5)).astype(dt)
+
+    params: Params = {
+        "embed": w((c.vocab_size, c.hidden_size), next(k)),
+        "layers": {
+            "input_norm": jnp.ones((Lc, c.hidden_size), dt),
+            "q_proj": stacked((c.hidden_size, c.num_heads * dh), next(k)),
+            "k_proj": stacked((c.hidden_size, c.num_kv_heads * dh), next(k)),
+            "v_proj": stacked((c.hidden_size, c.num_kv_heads * dh), next(k)),
+            "o_proj": stacked((c.num_heads * dh, c.hidden_size), next(k)),
+            "post_attn_norm": jnp.ones((Lc, c.hidden_size), dt),
+            "gate_proj": stacked((c.hidden_size, c.intermediate_size), next(k)),
+            "up_proj": stacked((c.hidden_size, c.intermediate_size), next(k)),
+            "down_proj": stacked((c.intermediate_size, c.hidden_size), next(k)),
+        },
+        "final_norm": jnp.ones((c.hidden_size,), dt),
+    }
+    if c.attention_bias:
+        params["layers"]["q_bias"] = jnp.zeros((Lc, c.num_heads * dh), dt)
+        params["layers"]["k_bias"] = jnp.zeros((Lc, c.num_kv_heads * dh), dt)
+        params["layers"]["v_bias"] = jnp.zeros((Lc, c.num_kv_heads * dh), dt)
+    if c.qk_norm:
+        params["layers"]["q_norm"] = jnp.ones((Lc, dh), dt)
+        params["layers"]["k_norm"] = jnp.ones((Lc, dh), dt)
+    if not c.tie_word_embeddings:
+        params["lm_head"] = w((c.hidden_size, c.vocab_size), next(k))
+    return params
+
+
+def attention_block(
+    lp: Params, config: ModelConfig, x: jax.Array, batch: Dict[str, jax.Array],
+    k_cache: jax.Array, v_cache: jax.Array, block_size: int, attn_backend: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared by dense and MoE models. Returns (attn_out, k_cache', v_cache')."""
+    c = config
+    dh = c.head_dim_
+    T = x.shape[0]
+
+    q = L.linear(x, lp["q_proj"], lp.get("q_bias")).reshape(T, c.num_heads, dh)
+    kx = L.linear(x, lp["k_proj"], lp.get("k_bias")).reshape(T, c.num_kv_heads, dh)
+    vx = L.linear(x, lp["v_proj"], lp.get("v_bias")).reshape(T, c.num_kv_heads, dh)
+    if c.qk_norm:
+        q = L.rms_norm(q, lp["q_norm"], c.rms_norm_eps)
+        kx = L.rms_norm(kx, lp["k_norm"], c.rms_norm_eps)
+
+    cos, sin = L.rope_cos_sin(batch["positions"], dh, c.rope_theta)
+    q = L.apply_rope(q, cos, sin)
+    kx = L.apply_rope(kx, cos, sin)
+
+    attn, k_cache, v_cache = attention_with_kv_update(
+        q, kx, vx, k_cache, v_cache, batch,
+        block_size=block_size, backend=attn_backend)
+    out = L.linear(attn.reshape(T, c.num_heads * dh), lp["o_proj"])
+    return out, k_cache, v_cache
+
+
+def forward(
+    params: Params,
+    kv_cache: Dict[str, jax.Array],   # {"k","v": [L, num_slots, KVH*dh]}
+    batch: Dict[str, jax.Array],
+    config: ModelConfig,
+    block_size: int,
+    attn_backend: str = "auto",
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One engine step over a ragged batch.
+
+    Returns (hidden states for sampling positions [S, D], updated kv cache).
+    """
+    c = config
+    x = params["embed"][batch["token_ids"]]          # [T, D]
+
+    def layer_body(carry, xs):
+        h = carry
+        lp, k_l, v_l = xs
+        a, k_l, v_l = attention_block(
+            lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
+            batch, k_l, v_l, block_size, attn_backend)
+        h = h + a
+        m = L.swiglu_mlp(
+            L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps),
+            lp["gate_proj"], lp["up_proj"], lp["down_proj"])
+        h = h + m
+        return h, (k_l, v_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_body, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+
+    x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    # Only sampling positions need logits: gather last-token rows per sequence.
+    sample_hidden = x[batch["sample_idx"]]           # [S, D]
+    return sample_hidden, {"k": k_new, "v": v_new}
+
+
+def compute_logits(params: Params, hidden: jax.Array, config: ModelConfig) -> jax.Array:
+    head = params.get("lm_head")
+    if head is None:                                  # tied embeddings
+        head = params["embed"].T
+    return jnp.dot(hidden, head, preferred_element_type=jnp.float32)
+
+
+def sharding_rules(config: ModelConfig):
+    """(path-regex, PartitionSpec) table for TP over the mesh's ``tp`` axis.
+
+    Column-parallel q/k/v/gate/up (+ lm_head), row-parallel o/down — the
+    Megatron layout the reference gets from vLLM's NCCL TP, expressed as
+    sharding annotations for XLA to lower onto ICI.
+    Stacked layer weights carry a leading L dim (hence leading None).
+    """
+    return [
+        (r"embed", P(None, "tp")),
+        (r"layers/(q|k|v)_proj", P(None, None, "tp")),
+        (r"layers/(q|k|v)_bias", P(None, "tp")),
+        (r"layers/(gate|up)_proj", P(None, None, "tp")),
+        (r"layers/o_proj", P(None, "tp", None)),
+        (r"layers/down_proj", P(None, "tp", None)),
+        (r"lm_head", P(None, "tp")),
+        # norms replicate (matched by default rule)
+    ]
+
+
+def kv_cache_spec() -> Dict[str, P]:
+    """KV cache sharding: folded head dim over tp (per-head D-blocks stay
+    contiguous when tp divides num_kv_heads), slots replicated."""
+    return {"k": P(None, None, "tp"), "v": P(None, None, "tp")}

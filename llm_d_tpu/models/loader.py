@@ -1,0 +1,130 @@
+"""Checkpoint loading: HuggingFace safetensors -> stacked param pytree.
+
+Serves the same role as vLLM's weight loader (model artifacts arrive as
+``hf://`` URIs in the reference; modelservice.md:25).  Weights are loaded
+layer-by-layer and stacked on a leading L axis to match the scanned forward;
+linear weights transpose from HF's [out, in] to our [in, out].
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Iterable, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from llm_d_tpu.models.config import ModelConfig
+
+# our stacked name -> HF per-layer suffix
+_LAYER_MAP = {
+    "input_norm": "input_layernorm.weight",
+    "q_proj": "self_attn.q_proj.weight",
+    "k_proj": "self_attn.k_proj.weight",
+    "v_proj": "self_attn.v_proj.weight",
+    "o_proj": "self_attn.o_proj.weight",
+    "q_bias": "self_attn.q_proj.bias",
+    "k_bias": "self_attn.k_proj.bias",
+    "v_bias": "self_attn.v_proj.bias",
+    "q_norm": "self_attn.q_norm.weight",
+    "k_norm": "self_attn.k_norm.weight",
+    "post_attn_norm": "post_attention_layernorm.weight",
+    "gate_proj": "mlp.gate_proj.weight",
+    "up_proj": "mlp.up_proj.weight",
+    "down_proj": "mlp.down_proj.weight",
+}
+_TRANSPOSE = {"q_proj", "k_proj", "v_proj", "o_proj",
+              "gate_proj", "up_proj", "down_proj"}
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    """torch tensor / numpy array -> numpy (bf16 via uint16 view round-trip)."""
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor
+    t = t.detach().cpu()
+    if str(t.dtype) == "torch.bfloat16":
+        return t.view(dtype=__import__("torch").uint16).numpy().view("<u2")
+    return t.numpy()
+
+
+def _get(weights: Mapping[str, Any], name: str) -> np.ndarray:
+    arr = _to_numpy(weights[name])
+    if arr.dtype == np.dtype("<u2"):
+        arr = arr.view(jnp.bfloat16.dtype) if hasattr(jnp.bfloat16, "dtype") else arr
+    return arr
+
+
+def load_dense_from_state_dict(
+    config: ModelConfig,
+    weights: Mapping[str, Any],
+    prefix: str = "model.",
+) -> Dict[str, Any]:
+    """Build the stacked param tree from a flat HF-style state dict
+    (torch tensors or numpy arrays)."""
+    c = config
+    dt = c.jax_dtype
+
+    def arr(name):
+        a = np.asarray(_to_numpy(weights[name]), dtype=np.float32)
+        return a
+
+    params: Dict[str, Any] = {
+        "embed": jnp.asarray(arr(f"{prefix}embed_tokens.weight"), dt),
+        "final_norm": jnp.asarray(arr(f"{prefix}norm.weight"), dt),
+        "layers": {},
+    }
+    for ours, hf_suffix in _LAYER_MAP.items():
+        name0 = f"{prefix}layers.0.{hf_suffix}"
+        if name0 not in weights:
+            continue
+        stack = []
+        for li in range(c.num_layers):
+            w = arr(f"{prefix}layers.{li}.{hf_suffix}")
+            if ours in _TRANSPOSE:
+                w = w.T
+            stack.append(w)
+        params["layers"][ours] = jnp.asarray(np.stack(stack), dt)
+    if not c.tie_word_embeddings:
+        head = arr("lm_head.weight").T
+        params["lm_head"] = jnp.asarray(head, dt)
+    return params
+
+
+def load_from_safetensors_dir(config: ModelConfig, path: str) -> Dict[str, Any]:
+    """Load all ``*.safetensors`` under ``path`` (a downloaded HF snapshot)."""
+    from safetensors import safe_open
+
+    weights: Dict[str, np.ndarray] = {}
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for key in f.keys():
+                weights[key] = f.get_tensor(key)
+    return load_dense_from_state_dict(config, weights)
+
+
+def config_from_hf_dir(path: str, name: str = "hf") -> ModelConfig:
+    """Derive a ModelConfig from an HF ``config.json``."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    return ModelConfig(
+        name=name,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim"),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=hf.get("attention_bias", False)
+        or hf.get("model_type") == "qwen2",
+        qk_norm=hf.get("model_type") == "qwen3",
+        max_model_len=min(hf.get("max_position_embeddings", 32000), 32000),
+    )

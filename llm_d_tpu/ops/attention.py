@@ -1,0 +1,276 @@
+"""Ragged paged attention: the engine's single attention entry point.
+
+One op serves mixed prefill+decode batches over the paged KV cache — the
+TPU-native counterpart of the reference's FlashInfer attention path
+(reference: docker/Dockerfile.cuda:57-58) married to vLLM's paged KV.  A
+single static-shape op keeps XLA tracing happy under continuous batching:
+the engine buckets the total token count T and the max sequence count S, so
+recompiles are bounded regardless of batch composition.
+
+Batch layout (all padded to bucketed sizes):
+  q:              [T, H, D]     query vectors for every token in this step
+  token_seq_ids:  [S_max] rows; token t belongs to sequence token_seq[t]
+  positions:      [T]           absolute position of each token in its seq
+  kv cache slots: [num_slots, KVH*D] per layer/side; slot = block*bs + off
+                  (heads folded into the lane dim: keeps DMA slices 128-
+                   aligned on TPU and scatter rows contiguous)
+  block_tables:   [S, B]        physical block ids per sequence (0 = null)
+  seq_lens:       [S]           total context length per sequence (0 = pad row)
+
+Block 0 is the reserved null/trash block: padding tokens write there and
+null table entries read from it (always masked out).
+
+The jnp reference implementation below is the correctness oracle and CPU
+path; ``llm_d_tpu.ops.pallas.paged_attention`` provides the TPU kernel and
+this module dispatches on backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ragged_paged_attention_reference(
+    q: jax.Array,              # [T, H, D]
+    k_cache: jax.Array,        # [num_slots, KVH*D] (this layer, new KV written)
+    v_cache: jax.Array,        # [num_slots, KVH*D]
+    token_seq_ids: jax.Array,  # [T] i32, sequence row per token (pad -> 0)
+    positions: jax.Array,      # [T] i32
+    block_tables: jax.Array,   # [S, B] i32
+    seq_lens: jax.Array,       # [S] i32
+    block_size: int,
+    scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+) -> jax.Array:               # [T, H, D]
+    T, H, D = q.shape
+    S, B = block_tables.shape
+    KVH = k_cache.shape[1] // D
+    G = H // KVH
+    scale = scale if scale is not None else D ** -0.5
+
+    # Gather each sequence's context from the paged cache: [S, C, KVH, D].
+    slot_ids = (block_tables[:, :, None] * block_size
+                + jnp.arange(block_size)[None, None, :]).reshape(S, B * block_size)
+    C = B * block_size
+    k_seq = k_cache[slot_ids].reshape(S, C, KVH, D)
+    v_seq = v_cache[slot_ids].reshape(S, C, KVH, D)
+
+    # Per-token context: [T, C, KVH, D].
+    k_tok = k_seq[token_seq_ids]
+    v_tok = v_seq[token_seq_ids]
+
+    qf = q.astype(jnp.float32).reshape(T, KVH, G, D)
+    scores = jnp.einsum("tkgd,tckd->tkgc", qf * scale,
+                        k_tok.astype(jnp.float32))  # [T, KVH, G, C]
+    if soft_cap is not None:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+
+    # Causal + length mask. key position c is valid for token t iff
+    # c <= positions[t] and c < seq_lens[seq(t)].
+    key_pos = jnp.arange(C)[None, :]                       # [1, C]
+    valid = (key_pos <= positions[:, None]) & (
+        key_pos < seq_lens[token_seq_ids][:, None])        # [T, C]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tkgc,tckd->tkgd", probs, v_tok.astype(jnp.float32))
+    return out.reshape(T, H, D).astype(q.dtype)
+
+
+def write_kv(
+    k_cache: jax.Array,      # [num_slots, KVH*D]
+    v_cache: jax.Array,
+    k_new: jax.Array,        # [T, KVH, D]
+    v_new: jax.Array,
+    slot_mapping: jax.Array,  # [T] i32 target slot per token (pad -> slot in block 0)
+):
+    """Scatter this step's KV into the paged cache (donated buffers).
+
+    Rows are contiguous KVH*D vectors -> each scatter row is one 1 KB burst.
+    The decode hot path bypasses this entirely: the Pallas kernel fuses the
+    row update into attention (see attention_with_kv_update).
+    """
+    T = k_new.shape[0]
+    k_cache = k_cache.at[slot_mapping].set(
+        k_new.reshape(T, -1).astype(k_cache.dtype))
+    v_cache = v_cache.at[slot_mapping].set(
+        v_new.reshape(T, -1).astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+def _flash_over_kv_chunks(
+    qs: jax.Array,        # [S, Q, H, D] padded per-seq queries
+    q_pos: jax.Array,     # [S, Q] absolute positions (pad -> -1)
+    slot_ids: jax.Array,  # [S, C] gather indices into the cache
+    seq_lens: jax.Array,  # [S]
+    k_cache: jax.Array, v_cache: jax.Array,
+    kv_chunk: int, scale: float, soft_cap: Optional[float],
+) -> jax.Array:           # [S, Q, H, D]
+    """Online-softmax attention scanning the context in kv_chunk slices.
+
+    Flash-attention recurrence expressed in XLA (lax.scan over KV chunks):
+    peak memory is O(S*Q*H*kv_chunk) instead of O(S*Q*H*C).  The Pallas
+    kernel supersedes this on TPU for the decode regime.
+    """
+    S, Q, H, D = qs.shape
+    KVH = k_cache.shape[1] // D
+    G = H // KVH
+    C = slot_ids.shape[1]
+    n_chunks = C // kv_chunk
+    qf = qs.astype(jnp.float32).reshape(S, Q, KVH, G, D) * scale
+
+    max_len = jnp.max(seq_lens)   # skip chunks past the longest context
+
+    def compute_chunk(carry, ci):
+        m, l, acc = carry
+        sl = jax.lax.dynamic_slice_in_dim(slot_ids, ci * kv_chunk, kv_chunk, 1)
+        k = k_cache[sl].reshape(S, kv_chunk, KVH, D).astype(jnp.float32)
+        v = v_cache[sl].reshape(S, kv_chunk, KVH, D).astype(jnp.float32)
+        s = jnp.einsum("sqkgd,sckd->sqkgc", qf, k)   # [S, Q, KVH, G, kc]
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        key_pos = ci * kv_chunk + jnp.arange(kv_chunk)
+        valid = (key_pos[None, None, :] <= q_pos[:, :, None]) & (
+            key_pos[None, None, :] < seq_lens[:, None, None])
+        s = jnp.where(valid[:, :, None, None, :], s, NEG_INF)
+        # Clamp the running max to a finite floor so fully-masked rows/chunks
+        # yield p = exp(NEG_INF - floor) = 0 instead of exp(0) = 1.
+        m_new = jnp.maximum(jnp.maximum(m, jnp.max(s, axis=-1)), -1e29)
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "sqkgc,sckd->sqkgd", p, v)
+        return m_new, l_new, acc_new
+
+    def chunk_step(carry, ci):
+        # Chunks entirely past the longest live context are skipped at
+        # runtime (scalar predicate -> only the taken branch executes), so
+        # HBM traffic tracks actual context length, not the padded table.
+        carry = jax.lax.cond(
+            ci * kv_chunk < max_len,
+            lambda c: compute_chunk(c, ci),
+            lambda c: c,
+            carry)
+        return carry, None
+
+    init = (jnp.full((S, Q, KVH, G), -1e29, jnp.float32),
+            jnp.zeros((S, Q, KVH, G), jnp.float32),
+            jnp.zeros((S, Q, KVH, G, D), jnp.float32))
+    (m, l, acc), _ = jax.lax.scan(chunk_step, init, jnp.arange(n_chunks))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(S, Q, H, D).astype(qs.dtype)
+
+
+def _chunk_size_for(C: int, target: int = 512) -> int:
+    kc = min(target, C)
+    while C % kc:
+        kc //= 2
+    return max(kc, 1)
+
+
+def ragged_paged_attention_chunked(
+    q: jax.Array,              # [T, H, D]
+    k_cache: jax.Array, v_cache: jax.Array,
+    token_seq_ids: jax.Array, positions: jax.Array,
+    block_tables: jax.Array, seq_lens: jax.Array,
+    qtok_idx: jax.Array,       # [S, Q] token index per (seq, q slot); T = pad
+    token_qpos: jax.Array,     # [T] q slot of each token within its seq
+    block_size: int, scale=None, soft_cap=None,
+) -> jax.Array:
+    """Memory-bounded ragged attention (XLA flash recurrence).
+
+    Decode steps (Q == 1) batch all sequences through one flash pass;
+    prefill/mixed steps map over sequences to bound the score tensor.
+    """
+    T, H, D = q.shape
+    S, B = block_tables.shape
+    Q = qtok_idx.shape[1]
+    scale = scale if scale is not None else D ** -0.5
+    C = B * block_size
+    kv_chunk = _chunk_size_for(C)
+
+    q_pad = jnp.concatenate([q, jnp.zeros((1, H, D), q.dtype)])
+    pos_pad = jnp.concatenate([positions, jnp.full((1,), -1, positions.dtype)])
+    qs = q_pad[qtok_idx]                        # [S, Q, H, D]
+    q_pos = pos_pad[qtok_idx]                   # [S, Q]
+    slot_ids = (block_tables[:, :, None] * block_size
+                + jnp.arange(block_size)[None, None, :]).reshape(S, C)
+
+    if Q == 1:
+        out = _flash_over_kv_chunks(
+            qs, q_pos, slot_ids, seq_lens, k_cache, v_cache,
+            kv_chunk, scale, soft_cap)          # [S, 1, H, D]
+    else:
+        def one_seq(args):
+            qs_s, qp_s, sl_s, slen_s = args
+            return _flash_over_kv_chunks(
+                qs_s[None], qp_s[None], sl_s[None], slen_s[None],
+                k_cache, v_cache, kv_chunk, scale, soft_cap)[0]
+        out = jax.lax.map(one_seq, (qs, q_pos, slot_ids, seq_lens))
+
+    return out[token_seq_ids, token_qpos]       # [T, H, D]
+
+
+def attention_with_kv_update(
+    q: jax.Array,            # [T, H, D]
+    k_new: jax.Array,        # [T, KVH, D] this step's K rows
+    v_new: jax.Array,
+    k_cache: jax.Array,      # [num_slots, KVH*D]
+    v_cache: jax.Array,
+    batch,                   # dict with the ragged-batch index arrays
+    block_size: int,
+    scale=None,
+    soft_cap=None,
+    backend: str = "auto",
+):
+    """Write this step's KV into the paged cache and attend over it.
+
+    One entry point for every backend so kernels may FUSE the update with
+    attention (the Pallas decode kernel does: single-row HBM scatters are
+    not DMA-alignable on TPU, so the row is spliced into the last page in
+    VMEM and the page written back).
+    Returns (attn_out [T, H, D], k_cache', v_cache').
+    """
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "reference"
+
+    qtok_idx = batch.get("qtok_idx")
+    # TPU DMA slices need sublane-aligned pages: the Pallas kernel requires
+    # block_size % 16 == 0 (bf16 tiling); smaller block sizes fall back to
+    # the chunked XLA path instead of failing Mosaic compilation.
+    if backend == "pallas" and qtok_idx is not None \
+            and qtok_idx.shape[1] == 1 and soft_cap is None \
+            and block_size % 16 == 0:
+        from llm_d_tpu.ops.pallas.paged_attention import (
+            paged_attention_decode_update)
+        T, H, D = q.shape
+        rows = qtok_idx[:, 0].clip(0, T - 1)
+        out, k_cache, v_cache = paged_attention_decode_update(
+            q[rows], k_new.reshape(T, -1)[rows].astype(k_cache.dtype),
+            v_new.reshape(T, -1)[rows].astype(v_cache.dtype),
+            k_cache, v_cache, batch["block_tables"], batch["seq_lens"],
+            block_size=block_size,
+            num_kv_heads=k_cache.shape[1] // D, scale=scale)
+        return out[batch["token_seq_ids"]], k_cache, v_cache
+
+    k_cache, v_cache = write_kv(
+        k_cache, v_cache, k_new, v_new, batch["slot_mapping"])
+    if backend in ("pallas", "chunked") and qtok_idx is not None:
+        out = ragged_paged_attention_chunked(
+            q, k_cache, v_cache, batch["token_seq_ids"], batch["positions"],
+            batch["block_tables"], batch["seq_lens"], qtok_idx,
+            batch["token_qpos"], block_size=block_size,
+            scale=scale, soft_cap=soft_cap)
+    else:
+        out = ragged_paged_attention_reference(
+            q, k_cache, v_cache, batch["token_seq_ids"], batch["positions"],
+            batch["block_tables"], batch["seq_lens"],
+            block_size=block_size, scale=scale, soft_cap=soft_cap)
+    return out, k_cache, v_cache

@@ -1,0 +1,61 @@
+"""Transformer building blocks (functional, shard-friendly).
+
+Pure functions over explicit parameter dicts: no framework modules, so
+pjit/shard_map see plain pytrees and XLA fuses elementwise work into the
+surrounding matmuls (MXU-friendly: keep matmuls in bf16 with f32
+accumulation via ``preferred_element_type``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_cos_sin(
+    positions: jax.Array,      # [T] i32
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling_factor: float = 1.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Rotary embedding tables for the given absolute positions: [T, D/2]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    pos = positions.astype(jnp.float32) / scaling_factor
+    freqs = pos[:, None] * inv_freq[None, :]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (HF 'half-rotation' convention). x: [T, H, D]."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[:, None, :].astype(x1.dtype)
+    sin = sin[:, None, :].astype(x1.dtype)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def linear(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None) -> jax.Array:
+    """x: [..., in], w: [in, out] (row-major for clean TP column sharding)."""
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y
+
+
+def swiglu_mlp(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+               w_down: jax.Array) -> jax.Array:
+    gate = linear(x, w_gate)
+    up = linear(x, w_up)
+    return linear(jax.nn.silu(gate) * up, w_down)

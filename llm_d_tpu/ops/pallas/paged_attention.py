@@ -1,0 +1,225 @@
+"""Pallas TPU paged-attention kernels.
+
+The FlashInfer-equivalent hot op (reference: docker/Dockerfile.cuda:57-58).
+XLA's generic row-gather reads the paged KV cache at ~5 GB/s on TPU (32k
+random 1 KB rows per step); these kernels instead DMA whole pages
+(contiguous [block_size, KVH*D] slabs in the folded cache layout) into VMEM
+double buffers and run the flash recurrence on-chip.
+
+GQA without batched matmuls: queries are zero-expanded into the folded
+[H, KVH*D] space (each head's row is nonzero only in its KV head's D-block),
+so scores for all heads come from ONE MXU dot per page:
+    scores = q_full [H, KVH*D] @ k_page.T [KVH*D, bs]  -> [H, bs]
+and the weighted values accumulate in folded space, unfolded once per
+sequence after the page loop.  This keeps every DMA 128-lane aligned even
+for head_dim 64 models and keeps the MXU fed with one large dot.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,   # [S, B] SMEM
+    seq_lens_ref,       # [S]    SMEM (context length INCLUDING the new token)
+    # inputs
+    q_ref,              # [1, H, D] VMEM (this sequence's query)
+    kn_ref,             # [1, 1, F] VMEM (this sequence's new K row)
+    vn_ref,             # [1, 1, F] VMEM
+    k_hbm,              # [num_slots, KVH*D] (ANY -> HBM, aliased to output)
+    v_hbm,              # [num_slots, KVH*D]
+    # outputs
+    o_ref,              # [1, H, D] VMEM
+    k_out,              # aliased k_hbm
+    v_out,              # aliased v_hbm
+    # scratch
+    k_buf,              # [2, bs, KVH*D] VMEM
+    v_buf,              # [2, bs, KVH*D] VMEM
+    sems,               # [2, 2] DMA semaphores (page loads)
+    wsems,              # [2]    DMA semaphores (page write-back)
+    *,
+    block_size: int,
+    num_kv_heads: int,
+    scale: float,
+):
+    """Fused decode attention + KV update.
+
+    The new token's KV row lives in the sequence's LAST page (decode
+    invariant: slot == seq_len - 1 position).  That page is already pulled
+    to VMEM for attention; the row is spliced in with a sublane mask, used
+    for attention, and the whole (DMA-aligned) page is written back —
+    single-row HBM scatters are not expressible as aligned TPU DMAs.
+    """
+    s = pl.program_id(0)
+    H, D = q_ref.shape[1], q_ref.shape[2]
+    KVH = num_kv_heads
+    G = H // KVH
+    F = KVH * D
+    bs = block_size
+    seq_len = seq_lens_ref[s]
+    n_pages = pl.cdiv(seq_len, bs)
+    # Decode invariant: the new token sits at position seq_len - 1, i.e. in
+    # LOGICAL page n_pages - 1, row (seq_len - 1) % bs.
+    write_page = (seq_len - 1) // bs
+    w_row = (seq_len - 1) % bs
+
+    def page_dma(slot, j):
+        b = block_tables_ref[s, j]
+        start = pl.multiple_of(b * bs, bs)
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[pl.ds(start, bs)], k_buf.at[slot], sems.at[slot, 0]),
+            pltpu.make_async_copy(
+                v_hbm.at[pl.ds(start, bs)], v_buf.at[slot], sems.at[slot, 1]),
+        )
+
+    @pl.when(n_pages > 0)
+    def _():
+        for dma in page_dma(0, 0):
+            dma.start()
+
+    # Zero-expanded queries: q_full[h, k*D+d] = q[h, d] if k == h // G else 0.
+    q = q_ref[0].astype(jnp.float32) * scale                  # [H, D]
+    q_rep = jnp.concatenate([q] * KVH, axis=1)                # [H, F]
+    col_kv = jax.lax.broadcasted_iota(jnp.int32, (H, F), 1) // D
+    row_kv = jax.lax.broadcasted_iota(jnp.int32, (H, F), 0) // G
+    block_mask = (col_kv == row_kv).astype(jnp.float32)       # [H, F]
+    q_full = q_rep * block_mask
+
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (bs, F), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = j % 2
+
+        @pl.when(j + 1 < n_pages)
+        def _():
+            for dma in page_dma((j + 1) % 2, j + 1):
+                dma.start()
+
+        for dma in page_dma(slot, j):
+            dma.wait()
+
+        @pl.when(j == write_page)
+        def _():
+            # Splice the new token's row into the page and write it back.
+            k_upd = jnp.where(row_ids == w_row, kn_ref[0], k_buf[slot])
+            v_upd = jnp.where(row_ids == w_row, vn_ref[0], v_buf[slot])
+            k_buf[slot] = k_upd
+            v_buf[slot] = v_upd
+            b = block_tables_ref[s, j]
+            start = pl.multiple_of(b * bs, bs)
+            wk = pltpu.make_async_copy(
+                k_buf.at[slot], k_out.at[pl.ds(start, bs)], wsems.at[0])
+            wv = pltpu.make_async_copy(
+                v_buf.at[slot], v_out.at[pl.ds(start, bs)], wsems.at[1])
+            wk.start()
+            wv.start()
+            wk.wait()
+            wv.wait()
+
+        k = k_buf[slot].astype(jnp.float32)                   # [bs, F]
+        v = v_buf[slot].astype(jnp.float32)
+        s_hb = jax.lax.dot_general(
+            q_full, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [H, bs]
+        key_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s_hb = jnp.where(key_pos < seq_len, s_hb, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_hb, axis=-1, keepdims=True))
+        p = jnp.exp(s_hb - m_new)                             # [H, bs]
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [H, F]
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((H, 1), -1e29, jnp.float32),
+        jnp.zeros((H, 1), jnp.float32),
+        jnp.zeros((H, F), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, init)
+    # Unfold: each head's output lives in its KV head's D-block.
+    masked = acc * block_mask                                 # [H, F]
+    out = masked[:, 0:D]
+    for kk in range(1, KVH):
+        out = out + masked[:, kk * D:(kk + 1) * D]
+    out = out / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "num_kv_heads", "scale", "soft_cap"))
+def paged_attention_decode_update(
+    q: jax.Array,             # [S, H, D]
+    k_new: jax.Array,         # [S, F] new K rows (one per sequence)
+    v_new: jax.Array,         # [S, F]
+    k_cache: jax.Array,       # [num_slots, KVH*D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [S, B]
+    seq_lens: jax.Array,      # [S] incl. the new token
+    block_size: int,
+    num_kv_heads: int,
+    scale: float | None = None,
+    soft_cap: float | None = None,
+):
+    """Returns (attn_out [S, H, D], k_cache', v_cache')."""
+    S, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    del soft_cap  # not yet supported in the kernel (no current model needs it)
+    F = k_cache.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, F), lambda s, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, F), lambda s, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, D), lambda s, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, F), k_cache.dtype),
+            pltpu.VMEM((2, block_size, F), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, block_size=block_size, num_kv_heads=num_kv_heads,
+        scale=scale)
+    # Operand indices in input_output_aliases include the scalar-prefetch args.
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((S, H, D), q.dtype),
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        input_output_aliases={5: 1, 6: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), has_side_effects=True),
+    )(block_tables, seq_lens, q,
+      k_new.reshape(S, 1, F), v_new.reshape(S, 1, F), k_cache, v_cache)

@@ -1,0 +1,11 @@
+from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh, AXIS_DP, AXIS_EP, AXIS_TP
+from llm_d_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_sharding,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshConfig", "make_mesh", "AXIS_DP", "AXIS_EP", "AXIS_TP",
+    "ShardingRules", "logical_to_sharding", "shard_pytree",
+]

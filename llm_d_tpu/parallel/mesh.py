@@ -1,0 +1,78 @@
+"""Device mesh construction.
+
+TPU-first replacement for the reference's three separate communication
+stacks (NCCL for TP/DP, NVSHMEM/DeepEP for EP, UCX/NIXL for KV transfer;
+reference: SURVEY.md §2.5): one ``jax.sharding.Mesh`` whose axes XLA lowers
+to ICI/DCN collectives.  The env-var zoo (``NCCL_*``, ``NVSHMEM_*``,
+``UCX_TLS``) collapses into this module.
+
+Axes:
+  - ``dp``: data parallelism over requests ("DP attention" in wide-EP;
+    reference: decode.yaml:73-93 ``--data-parallel-size``).
+  - ``sp``: sequence/context parallelism for long sequences (ring attention).
+    The reference has no SP (SURVEY.md §2.3); we make it first-class.
+  - ``tp``: tensor parallelism within a replica
+    (reference: ``--tensor-parallel-size``, ms-pd/values.yaml:34-35).
+
+Expert parallelism for MoE layers runs over the *flattened* ``(dp, sp, tp)``
+axes — the same devices that are data-parallel for attention are
+expert-parallel for MoE, exactly the wide-EP regime ("TPxDP in attention,
+EP in MoE layers"; reference: decode.yaml:76,87).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+# Logical EP axis = all mesh axes flattened (used in PartitionSpec as a tuple).
+AXIS_EP: Tuple[str, ...] = (AXIS_DP, AXIS_SP, AXIS_TP)
+MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_TP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    @property
+    def ep(self) -> int:
+        """Expert-parallel degree: all devices participate in MoE EP."""
+        return self.num_devices
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 3D mesh (dp, sp, tp) over ``devices``.
+
+    Default config: all local devices on the ``tp`` axis (single-replica
+    tensor parallelism, the most common single-slice serving layout).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        config = MeshConfig(tp=len(devices))
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f"mesh {config} needs {config.num_devices} devices, got {len(devices)}")
+    arr = np.asarray(devices).reshape(config.dp, config.sp, config.tp)
+    return Mesh(arr, MESH_AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    device = device or jax.devices()[0]
+    return make_mesh(MeshConfig(), [device])

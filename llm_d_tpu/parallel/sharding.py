@@ -1,0 +1,76 @@
+"""Parameter/activation sharding rules.
+
+The reference distributes weights with NCCL-backed TP inside vLLM; here a
+declarative table of (param-path regex -> PartitionSpec) is applied to the
+parameter pytree and handed to ``jax.jit`` in/out shardings — XLA inserts all
+collectives (the scaling-book recipe: pick a mesh, annotate shardings, let
+XLA do the rest).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# One rule: (regex over "/"-joined param path, PartitionSpec).
+ShardingRules = Sequence[Tuple[str, P]]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for_path(rules: ShardingRules, path: str, leaf: Any) -> P:
+    if getattr(leaf, "ndim", 0) == 0:
+        return P()
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return P()  # replicate by default
+
+
+def logical_to_sharding(rules: ShardingRules, params: Any, mesh: Mesh) -> Any:
+    """Pytree of NamedSharding matching ``params``' structure."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, spec_for_path(rules, _path_str(path), leaf)),
+        params)
+
+
+def shard_pytree(params: Any, shardings: Any) -> Any:
+    """Place a (host or single-device) pytree onto the mesh."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s), params, shardings)
+
+
+def validate_divisibility(rules: ShardingRules, params: Any, mesh: Mesh) -> List[str]:
+    """Return human-readable problems where a sharded dim doesn't divide."""
+    problems: List[str] = []
+
+    def check(path, leaf):
+        p = _path_str(path)
+        spec = spec_for_path(rules, p, leaf)
+        for dim, axes in enumerate(spec):
+            if axes is None:
+                continue
+            axes = axes if isinstance(axes, tuple) else (axes,)
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            if leaf.shape[dim] % size:
+                problems.append(
+                    f"{p}: dim {dim} ({leaf.shape[dim]}) % mesh{axes}={size} != 0")
+
+    jax.tree_util.tree_map_with_path(check, params)
+    return problems

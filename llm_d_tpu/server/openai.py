@@ -1,0 +1,280 @@
+"""OpenAI-compatible model server (the vLLM API-server equivalent).
+
+Endpoints and probe semantics follow the reference's contract exactly so the
+gateway/EPP/monitoring stack sees an identical surface
+(reference: docs/readiness-probes.md:30-67):
+
+  GET  /health          -> 200 as soon as the process is up (liveness)
+  GET  /v1/models       -> 200 only once the model is loaded (startup,
+                           readiness: "model-aware readiness" doctrine)
+  GET  /metrics         -> Prometheus text, ``vllm:*`` taxonomy
+  POST /v1/completions  -> OpenAI completions (+SSE streaming)
+  POST /v1/chat/completions -> OpenAI chat (+SSE streaming)
+
+PD disaggregation: requests may carry ``kv_transfer_params`` and the special
+``max_tokens=1`` + ``do_remote_decode`` contract; responses then include
+``kv_transfer_params{remote_block_ids, remote_host, remote_port, uuid}``
+(reference: README.tpu.md:182-189).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+import uuid as uuid_mod
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from llm_d_tpu.engine.async_engine import AsyncEngine
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+from llm_d_tpu.engine.request import Request
+from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.utils.tokenizer import get_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+def _sampling_from_body(body: Dict[str, Any]) -> SamplingParams:
+    return SamplingParams(
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        max_tokens=int(body.get("max_tokens", body.get("max_completion_tokens", 16))),
+        min_tokens=int(body.get("min_tokens", 0)),
+        stop=tuple(body.get("stop") or ()),
+        seed=body.get("seed"),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+        logprobs=body.get("logprobs"),
+    )
+
+
+class ModelServer:
+    def __init__(self, engine: EngineCore, tokenizer, model_name: str) -> None:
+        self.engine = engine
+        self.async_engine = AsyncEngine(engine)
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.model_loaded = False
+        self.started_at = time.time()
+        if tokenizer.eos_token_id is not None:
+            engine.eos_token_id = tokenizer.eos_token_id
+
+    # ---------- app ----------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/version", self.version)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/tokenize", self.tokenize)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        await self.async_engine.start()
+        self.model_loaded = True
+
+    async def _on_cleanup(self, app) -> None:
+        self.async_engine.stop()
+
+    # ---------- probes / meta ----------
+
+    async def health(self, request: web.Request) -> web.Response:
+        if self.async_engine.dead is not None:
+            return web.Response(status=500, text="engine dead")
+        return web.Response(text="ok")
+
+    async def models(self, request: web.Request) -> web.Response:
+        if not self.model_loaded:
+            return web.json_response({"error": "model loading"}, status=503)
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model_name, "object": "model",
+                      "created": int(self.started_at), "owned_by": "llm-d-tpu"}],
+        })
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.engine.metrics.render(),
+                            content_type="text/plain")
+
+    async def version(self, request: web.Request) -> web.Response:
+        from llm_d_tpu import __version__
+        return web.json_response({"version": __version__})
+
+    async def tokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        ids = self.tokenizer.encode(body.get("prompt", ""))
+        return web.json_response({"tokens": ids, "count": len(ids)})
+
+    # ---------- inference ----------
+
+    def _make_request(self, body: Dict[str, Any], prompt_ids: List[int]) -> Request:
+        rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
+        req = Request(
+            request_id=rid,
+            prompt_token_ids=prompt_ids,
+            sampling=_sampling_from_body(body),
+            priority=int(body.get("priority", 0)),
+        )
+        ktp = body.get("kv_transfer_params")
+        if ktp:
+            if ktp.get("do_remote_decode"):
+                # Producer role: run prefill only, pin KV for remote pull.
+                req.do_remote_decode = True
+            elif ktp.get("remote_block_ids") or ktp.get("do_remote_prefill"):
+                req.do_remote_prefill = True
+                req.kv_transfer_params = ktp
+        return req
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid json"}, status=400)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            prompt_ids = prompt
+        else:
+            prompt_ids = self.tokenizer.encode(str(prompt))
+        return await self._run(request, body, prompt_ids, chat=False)
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid json"}, status=400)
+        messages = body.get("messages", [])
+        if hasattr(self.tokenizer, "_tok") and hasattr(
+                self.tokenizer._tok, "apply_chat_template"):
+            prompt_ids = self.tokenizer._tok.apply_chat_template(
+                messages, add_generation_prompt=True)
+        else:
+            text = "".join(
+                f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
+            ) + "<|assistant|>"
+            prompt_ids = self.tokenizer.encode(text)
+        return await self._run(request, body, prompt_ids, chat=True)
+
+    async def _run(self, http_req: web.Request, body: Dict[str, Any],
+                   prompt_ids: List[int], chat: bool) -> web.StreamResponse:
+        req = self._make_request(body, prompt_ids)
+        stream = bool(body.get("stream", False))
+        created = int(time.time())
+
+        if stream:
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache"})
+            await resp.prepare(http_req)
+            all_text_len = 0
+            async for out in self.async_engine.generate(req):
+                text = self.tokenizer.decode(req.output_token_ids)
+                delta, all_text_len = text[all_text_len:], len(text)
+                delta, stopped = self._apply_stop_strings(req, delta, text)
+                chunk = self._chunk(req, delta, out, created, chat)
+                await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+                if stopped:
+                    self.engine.abort_request(req.request_id)
+                    break
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+
+        final_out = None
+        async for out in self.async_engine.generate(req):
+            final_out = out
+        text = self.tokenizer.decode(req.output_token_ids)
+        text, _ = self._apply_stop_strings(req, text, text)
+        payload = {
+            "id": req.request_id,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": created,
+            "model": self.model_name,
+            "choices": [{
+                "index": 0,
+                "finish_reason": final_out.finish_reason if final_out else None,
+                **({"message": {"role": "assistant", "content": text}}
+                   if chat else {"text": text}),
+            }],
+            "usage": {
+                "prompt_tokens": req.num_prompt_tokens,
+                "completion_tokens": len(req.output_token_ids),
+                "total_tokens": req.num_tokens,
+            },
+        }
+        if final_out is not None and final_out.kv_transfer_params:
+            payload["kv_transfer_params"] = final_out.kv_transfer_params
+        return web.json_response(payload)
+
+    def _apply_stop_strings(self, req: Request, delta: str, full: str):
+        """Truncate output at the first stop string. Returns (delta', stopped)."""
+        for s in req.sampling.stop:
+            idx = full.find(s)
+            if idx >= 0:
+                delta_start = len(full) - len(delta)
+                return (full[delta_start:idx] if idx > delta_start else ""), True
+        return delta, False
+
+    def _chunk(self, req, delta: str, out, created: int, chat: bool):
+        choice: Dict[str, Any] = {
+            "index": 0,
+            "finish_reason": out.finish_reason if out.finished else None}
+        if chat:
+            choice["delta"] = {"content": delta}
+        else:
+            choice["text"] = delta
+        chunk = {
+            "id": req.request_id,
+            "object": "chat.completion.chunk" if chat else "text_completion",
+            "created": created, "model": self.model_name,
+            "choices": [choice],
+        }
+        if out.finished and out.kv_transfer_params:
+            chunk["kv_transfer_params"] = out.kv_transfer_params
+        return chunk
+
+
+def build_server(engine_config: EngineConfig, tokenizer_name: Optional[str] = None,
+                 model_name: Optional[str] = None,
+                 engine: Optional[EngineCore] = None) -> ModelServer:
+    engine = engine or EngineCore(engine_config)
+    tok = get_tokenizer(tokenizer_name)
+    return ModelServer(engine, tok,
+                       model_name or engine_config.resolve_model().name)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser("llmd-serve")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--block-size", type=int, default=32)
+    p.add_argument("--num-blocks", type=int, default=2048)
+    p.add_argument("--max-num-seqs", type=int, default=128)
+    p.add_argument("--max-num-batched-tokens", type=int, default=2048)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    args = p.parse_args(argv)
+
+    from llm_d_tpu.parallel.mesh import MeshConfig
+    cfg = EngineConfig(
+        model=args.model, block_size=args.block_size,
+        num_blocks=args.num_blocks, max_num_seqs=args.max_num_seqs,
+        max_num_batched_tokens=args.max_num_batched_tokens,
+        mesh=MeshConfig(tp=args.tensor_parallel_size)
+        if args.tensor_parallel_size > 1 else None)
+    server = build_server(cfg, args.tokenizer)
+    logging.basicConfig(level=logging.INFO)
+    web.run_app(server.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""Prometheus metrics with the llm-d metric taxonomy.
+
+The reference stack's observability contract is metrics-first: every model
+server exposes ``vllm:*`` metrics that the scheduler scrapes for load
+balancing, and the EPP exposes ``inference_extension_*`` /
+``llm_d_inference_scheduler_*`` metrics (reference:
+docs/monitoring/example-promQL-queries.md:8-80, SURVEY.md §5).  We reproduce
+the same names so existing dashboards/PromQL and the scoring contract carry
+over unchanged.
+
+Uses ``prometheus_client`` under a private registry per component so several
+components can live in one test process.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+# Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
+_TIME_BUCKETS = (
+    0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5,
+    0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 20.0, 40.0, 80.0,
+)
+
+
+class EngineMetrics:
+    """The ``vllm:*`` metric family exposed by every model-server replica.
+
+    The EPP's load-aware scorers consume exactly these
+    (kv-cache-utilization-scorer and queue-scorer read
+    ``vllm:kv_cache_usage_perc`` / ``vllm:num_requests_waiting``; reference:
+    gaie-inference-scheduling/values.yaml:4-6, gaie-kv-events/values.yaml:58-59).
+    """
+
+    def __init__(self, model_name: str, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self.model_name = model_name
+        labels = {"model_name": model_name}
+
+        def gauge(name: str, doc: str) -> Gauge:
+            g = Gauge(name, doc, list(labels), registry=self.registry)
+            return g.labels(**labels)
+
+        def counter(name: str, doc: str) -> Counter:
+            c = Counter(name, doc, list(labels), registry=self.registry)
+            return c.labels(**labels)
+
+        def histo(name: str, doc: str, buckets=_TIME_BUCKETS) -> Histogram:
+            h = Histogram(name, doc, list(labels), buckets=buckets, registry=self.registry)
+            return h.labels(**labels)
+
+        # Scheduler-consumed load signals.
+        self.kv_cache_usage_perc = gauge(
+            "vllm:kv_cache_usage_perc", "Fraction of KV-cache blocks in use (0..1).")
+        self.num_requests_waiting = gauge(
+            "vllm:num_requests_waiting", "Requests queued, not yet scheduled.")
+        self.num_requests_running = gauge(
+            "vllm:num_requests_running", "Requests currently in the running batch.")
+        # Latency distributions.
+        self.time_to_first_token = histo(
+            "vllm:time_to_first_token_seconds", "Time from arrival to first output token.")
+        self.inter_token_latency = histo(
+            "vllm:inter_token_latency_seconds", "Latency between consecutive output tokens.")
+        self.e2e_request_latency = histo(
+            "vllm:e2e_request_latency_seconds", "End-to-end request latency.")
+        # Prefix-cache effectiveness (approximate-scorer calibration input).
+        self.prefix_cache_queries = counter(
+            "vllm:prefix_cache_queries_total", "Tokens queried against the prefix cache.")
+        self.prefix_cache_hits = counter(
+            "vllm:prefix_cache_hits_total", "Tokens served from the prefix cache.")
+        # Work counters.
+        self.prompt_tokens = counter(
+            "vllm:prompt_tokens_total", "Prefill tokens processed.")
+        self.generation_tokens = counter(
+            "vllm:generation_tokens_total", "Output tokens generated.")
+        self.request_success = Counter(
+            "vllm:request_success", "Finished requests.",
+            ["model_name", "finished_reason"], registry=self.registry)
+        self.preemptions = counter(
+            "vllm:num_preemptions_total", "Requests preempted to reclaim KV blocks.")
+        # Gaps the reference documents as missing (example-promQL-queries.md:104-121)
+        # -- we close them.
+        self.kv_transfer_time = histo(
+            "llmd_tpu:kv_transfer_seconds", "P->D KV-cache transfer time per request.")
+        self.kv_cache_evictions = counter(
+            "llmd_tpu:kv_cache_evictions_total", "Cached KV blocks evicted (LRU).")
+        self.kv_offload_saves = counter(
+            "llmd_tpu:kv_offload_saved_blocks_total", "KV blocks offloaded to host tier.")
+        self.kv_offload_loads = counter(
+            "llmd_tpu:kv_offload_loaded_blocks_total", "KV blocks restored from host tier.")
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class EppMetrics:
+    """Scheduler-side metrics (``inference_extension_*`` family and the PD
+    decision counter; reference: example-promQL-queries.md:40-80)."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self.scheduling_duration = Histogram(
+            "inference_extension_scheduler_e2e_duration_seconds",
+            "End-to-end scheduling latency per request.",
+            registry=self.registry,
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5))
+        self.plugin_duration = Histogram(
+            "inference_extension_scheduler_plugin_duration_seconds",
+            "Per-plugin processing latency.", ["plugin"],
+            registry=self.registry,
+            buckets=(0.00001, 0.0001, 0.001, 0.01, 0.1))
+        self.pd_decisions = Counter(
+            "llm_d_inference_scheduler_pd_decision_total",
+            "Prefill/decode disaggregation decisions.", ["decision_type"],
+            registry=self.registry)
+        self.prefix_indexer_size = Gauge(
+            "inference_extension_prefix_indexer_size",
+            "Blocks tracked by the prefix indexer.", registry=self.registry)
+        self.prefix_indexer_hit_ratio = Gauge(
+            "inference_extension_prefix_indexer_hit_ratio",
+            "Prefix indexer hit ratio over recent requests.", registry=self.registry)
+        self.flow_control_queue = Gauge(
+            "inference_extension_flow_control_queue_size",
+            "Requests held by gateway flow control.", registry=self.registry)
+        self.requests_total = Counter(
+            "inference_objective_request_total",
+            "Requests scheduled.", ["target"], registry=self.registry)
+        self.shed_total = Counter(
+            "inference_objective_request_shed_total",
+            "Requests shed due to SLO headroom exhaustion.", registry=self.registry)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def parse_prometheus_text(text: str) -> Dict[str, float]:
+    """Tiny parser for the exposition format: returns ``{metric{labels}: value}``
+    plus bare ``{metric: value}`` for the first sample of each name.
+
+    This is what the EPP metrics scraper uses against model-server ``/metrics``
+    (the reference EPP scrapes vLLM the same way)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, value = line.rsplit(" ", 1)
+            # Drop optional timestamp.
+            parts = value.split()
+            val = float(parts[0])
+        except ValueError:
+            continue
+        out[key] = val
+        bare = key.split("{", 1)[0]
+        out.setdefault(bare, val)
+    return out
+
+
+class StopWatch:
+    """Context manager feeding a Histogram."""
+
+    def __init__(self, histogram):
+        self.histogram = histogram
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.histogram.observe(time.perf_counter() - self._t0)
+        return False

@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip hardware isn't available in CI; sharding/collective code is
+validated on ``--xla_force_host_platform_device_count=8`` CPU devices, the
+same mechanism the driver's ``dryrun_multichip`` uses.
+
+Note: the environment's TPU plugin re-registers itself and overrides
+``JAX_PLATFORMS`` from the environment, so the CPU pin must go through
+``jax.config`` after import (before first backend use).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, devs
+    return devs

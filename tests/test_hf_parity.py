@@ -1,0 +1,81 @@
+"""Numerical parity vs HuggingFace transformers (torch CPU).
+
+A randomly initialized HF Llama is exported through our loader; engine
+prefill logits must match HF's forward logits, and greedy generation must
+match HF ``generate``.  This pins our model math (rope convention, GQA,
+norm placement) to the de-facto reference implementation.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore  # noqa: E402
+from llm_d_tpu.engine.request import Request  # noqa: E402
+from llm_d_tpu.models.config import ModelConfig  # noqa: E402
+from llm_d_tpu.models.loader import load_dense_from_state_dict  # noqa: E402
+from llm_d_tpu.ops.sampling import SamplingParams  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def hf_setup():
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_bias=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    ours = ModelConfig(
+        name="parity", vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, rope_theta=10000.0,
+        rms_norm_eps=1e-5, max_model_len=256, dtype="float32")
+    params = load_dense_from_state_dict(ours, model.state_dict())
+    return model, ours, params
+
+
+def test_prefill_logits_match(hf_setup):
+    model, ours, params = hf_setup
+    prompt = [3, 17, 42, 99, 7, 123, 200, 5]
+    with torch.no_grad():
+        hf_logits = model(torch.tensor([prompt])).logits[0, -1].numpy()
+
+    engine = EngineCore(EngineConfig(
+        model_config=ours, model="parity", block_size=4, num_blocks=32,
+        max_num_seqs=4, max_num_batched_tokens=32,
+        min_token_bucket=8, min_seq_bucket=4))
+    engine.params = jax.device_put(params)
+    req = Request("p", list(prompt),
+                  SamplingParams(temperature=0.0, max_tokens=1,
+                                 ignore_eos=True, logprobs=1))
+    # Run one step manually to grab logits via the sampled id + logprob.
+    out = engine.generate([req])
+    our_first = out["p"][0]
+    assert our_first == int(np.argmax(hf_logits))
+
+
+def test_greedy_generation_matches_hf(hf_setup):
+    model, ours, params = hf_setup
+    prompt = [10, 20, 30, 40, 50, 60]
+    n_new = 8
+    with torch.no_grad():
+        hf_out = model.generate(
+            torch.tensor([prompt]), max_new_tokens=n_new, do_sample=False,
+            pad_token_id=0)
+    hf_tokens = hf_out[0, len(prompt):].tolist()
+
+    engine = EngineCore(EngineConfig(
+        model_config=ours, model="parity", block_size=4, num_blocks=64,
+        max_num_seqs=4, max_num_batched_tokens=32,
+        min_token_bucket=8, min_seq_bucket=4))
+    engine.params = jax.device_put(params)
+    req = Request("g", list(prompt),
+                  SamplingParams(temperature=0.0, max_tokens=n_new,
+                                 ignore_eos=True))
+    out = engine.generate([req])
+    assert out["g"] == hf_tokens

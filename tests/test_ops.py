@@ -1,0 +1,231 @@
+"""Unit tests for core ops: attention oracle, sampling, layers, hashing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_d_tpu.ops.attention import ragged_paged_attention_reference, write_kv
+from llm_d_tpu.ops.layers import apply_rope, rms_norm, rope_cos_sin
+from llm_d_tpu.ops.sampling import sample
+from llm_d_tpu.utils.hashing import hash_block, hash_token_blocks
+
+
+def dense_attention(q, k, v, scale):
+    """Plain causal attention oracle: q,k,v [T, H, D] for one sequence."""
+    T, H, D = q.shape
+    KVH = k.shape[1]
+    G = H // KVH
+    qf = q.astype(jnp.float32).reshape(T, KVH, G, D)
+    scores = jnp.einsum("tkgd,skd->tkgs", qf * scale, k.astype(jnp.float32))
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tkgs,skd->tkgd", probs, v.astype(jnp.float32))
+    return out.reshape(T, H, D)
+
+
+def test_paged_attention_matches_dense_single_seq():
+    """One sequence paged across blocks == dense causal attention."""
+    key = jax.random.PRNGKey(0)
+    T, H, KVH, D, bs = 10, 4, 2, 16, 4
+    num_blocks = 8
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (T, H, D), jnp.float32)
+    k = jax.random.normal(kk, (T, KVH, D), jnp.float32)
+    v = jax.random.normal(kv_, (T, KVH, D), jnp.float32)
+
+    k_cache = jnp.zeros((num_blocks * bs, KVH * D))
+    v_cache = jnp.zeros((num_blocks * bs, KVH * D))
+    block_ids = [2, 5, 1]   # non-contiguous on purpose
+    slot_mapping = jnp.array(
+        [block_ids[i // bs] * bs + i % bs for i in range(T)], jnp.int32)
+    k_cache, v_cache = write_kv(k_cache, v_cache, k, v, slot_mapping)
+
+    block_tables = jnp.zeros((2, 4), jnp.int32).at[0, :3].set(jnp.array(block_ids))
+    out = ragged_paged_attention_reference(
+        q, k_cache, v_cache,
+        token_seq_ids=jnp.zeros(T, jnp.int32),
+        positions=jnp.arange(T, dtype=jnp.int32),
+        block_tables=block_tables,
+        seq_lens=jnp.array([T, 0], jnp.int32),
+        block_size=bs)
+    expected = dense_attention(q, k, v, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_paged_attention_mixed_batch():
+    """Decode tokens of seq A + prefill chunk of seq B in one ragged batch."""
+    key = jax.random.PRNGKey(1)
+    H, KVH, D, bs = 4, 2, 8, 4
+    num_blocks = 16
+    lenA, lenB = 7, 5     # A: 6 in cache + 1 decode token; B: full prefill
+    kq, kk = jax.random.split(key)
+    kA = jax.random.normal(kq, (lenA, KVH, D))
+    vA = jax.random.normal(kk, (lenA, KVH, D))
+    kB = jax.random.normal(jax.random.PRNGKey(2), (lenB, KVH, D))
+    vB = jax.random.normal(jax.random.PRNGKey(3), (lenB, KVH, D))
+    qA = jax.random.normal(jax.random.PRNGKey(4), (1, H, D))   # decode token
+    qB = jax.random.normal(jax.random.PRNGKey(5), (lenB, H, D))
+
+    k_cache = jnp.zeros((num_blocks * bs, KVH * D))
+    v_cache = jnp.zeros((num_blocks * bs, KVH * D))
+    blocksA, blocksB = [1, 2], [3, 4]
+    slotsA = [blocksA[i // bs] * bs + i % bs for i in range(lenA)]
+    slotsB = [blocksB[i // bs] * bs + i % bs for i in range(lenB)]
+    k_cache, v_cache = write_kv(
+        k_cache, v_cache, jnp.concatenate([kA, kB]), jnp.concatenate([vA, vB]),
+        jnp.array(slotsA + slotsB, jnp.int32))
+
+    T = 1 + lenB
+    q = jnp.concatenate([qA, qB])
+    token_seq_ids = jnp.array([0] + [1] * lenB, jnp.int32)
+    positions = jnp.array([lenA - 1] + list(range(lenB)), jnp.int32)
+    block_tables = jnp.zeros((2, 4), jnp.int32)
+    block_tables = block_tables.at[0, :2].set(jnp.array(blocksA))
+    block_tables = block_tables.at[1, :2].set(jnp.array(blocksB))
+    seq_lens = jnp.array([lenA, lenB], jnp.int32)
+
+    out = ragged_paged_attention_reference(
+        q, k_cache, v_cache, token_seq_ids, positions, block_tables,
+        seq_lens, block_size=bs)
+
+    # Oracle per sequence.
+    qA_full = jnp.zeros((lenA, H, D)).at[lenA - 1].set(qA[0])
+    expA = dense_attention(qA_full, kA, vA, D ** -0.5)[lenA - 1]
+    expB = dense_attention(qB, kB, vB, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(expA),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[1:]), np.asarray(expB),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sampling_greedy_and_topk():
+    logits = jnp.array([[1.0, 5.0, 2.0, 0.0],
+                        [0.0, 0.0, 0.0, 9.0]])
+    key = jax.random.PRNGKey(0)
+    ids = sample(logits,
+                 temperature=jnp.array([0.0, 0.0]),
+                 top_k=jnp.array([0, 0]),
+                 top_p=jnp.array([1.0, 1.0]), key=key)
+    assert list(np.asarray(ids)) == [1, 3]
+
+    # top_k=1 must equal greedy even at high temperature.
+    ids = sample(logits,
+                 temperature=jnp.array([10.0, 10.0]),
+                 top_k=jnp.array([1, 1]),
+                 top_p=jnp.array([1.0, 1.0]), key=key)
+    assert list(np.asarray(ids)) == [1, 3]
+
+
+def test_sampling_top_p_excludes_tail():
+    # Token 0 has prob ~0.88 at temp 1; top_p=0.5 must always pick it.
+    logits = jnp.tile(jnp.array([[5.0, 3.0, 1.0, 0.0]]), (1, 1))
+    for s in range(20):
+        ids = sample(logits, jnp.array([1.0]), jnp.array([0]),
+                     jnp.array([0.5]), jax.random.PRNGKey(s))
+        assert int(ids[0]) == 0
+
+
+def test_rope_rotation_preserves_norm():
+    pos = jnp.arange(6, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(pos, 8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, 2, 8))
+    y = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-5)
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(x[0]), np.asarray(y[0]), rtol=1e-6)
+
+
+def test_rms_norm():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16)) * 10
+    y = rms_norm(x, jnp.ones(16))
+    rms = np.sqrt(np.mean(np.asarray(y) ** 2, axis=-1))
+    np.testing.assert_allclose(rms, 1.0, rtol=1e-3)
+
+
+def test_block_hash_chain():
+    toks = list(range(200))
+    h1 = hash_token_blocks(toks, block_size=64)
+    assert len(h1) == 3           # 200 // 64
+    # Deterministic and prefix-stable.
+    h2 = hash_token_blocks(toks[:128], block_size=64)
+    assert h1[:2] == h2
+    # Different parent -> different hash for same tokens.
+    a = hash_block(None, [1, 2, 3])
+    b = hash_block(a, [1, 2, 3])
+    assert a != b
+
+
+def test_chunked_backend_matches_reference():
+    """Flash-chunked path == reference on a mixed prefill+decode batch."""
+    import numpy as onp
+    from llm_d_tpu.ops.attention import ragged_paged_attention_chunked
+
+    rng = onp.random.default_rng(0)
+    H, KVH, D, bs = 4, 2, 8, 4
+    num_blocks, B = 16, 8          # C = 32, kv chunks exercise the scan
+    S = 3
+    # seq 0: decode (1 token, context 9); seq 1: prefill 6; seq 2: pad row
+    qlens = [1, 6, 0]
+    seq_lens = onp.array([9, 6, 0], onp.int32)
+    T = 8                           # 7 real + 1 pad
+    q = rng.standard_normal((T, H, D), dtype=onp.float32)
+    k_cache = rng.standard_normal((num_blocks * bs, KVH * D), dtype=onp.float32)
+    v_cache = rng.standard_normal((num_blocks * bs, KVH * D), dtype=onp.float32)
+
+    block_tables = onp.zeros((S, B), onp.int32)
+    block_tables[0, :3] = [1, 2, 3]
+    block_tables[1, :2] = [4, 5]
+    token_seq_ids = onp.array([0, 1, 1, 1, 1, 1, 1, 0], onp.int32)
+    positions = onp.array([8, 0, 1, 2, 3, 4, 5, 0], onp.int32)
+    token_qpos = onp.array([0, 0, 1, 2, 3, 4, 5, 0], onp.int32)
+    Q = 8
+    qtok_idx = onp.full((S, Q), T, onp.int32)
+    qtok_idx[0, 0] = 0
+    qtok_idx[1, :6] = onp.arange(1, 7)
+
+    args = [jnp.asarray(x) for x in (
+        q, k_cache, v_cache, token_seq_ids, positions, block_tables, seq_lens)]
+    ref = ragged_paged_attention_reference(*args, block_size=bs)
+    got = ragged_paged_attention_chunked(
+        *args, qtok_idx=jnp.asarray(qtok_idx),
+        token_qpos=jnp.asarray(token_qpos), block_size=bs)
+    np.testing.assert_allclose(
+        np.asarray(got[:7]), np.asarray(ref[:7]), rtol=2e-5, atol=2e-5)
+
+
+def test_chunked_backend_decode_only_path():
+    """Q == 1 fast path (batched flash) == reference."""
+    import numpy as onp
+    from llm_d_tpu.ops.attention import ragged_paged_attention_chunked
+
+    rng = onp.random.default_rng(1)
+    H, KVH, D, bs = 8, 4, 16, 4
+    num_blocks, B, S = 32, 16, 4
+    q = rng.standard_normal((S, H, D), dtype=onp.float32)
+    k_cache = rng.standard_normal((num_blocks * bs, KVH * D), dtype=onp.float32)
+    v_cache = rng.standard_normal((num_blocks * bs, KVH * D), dtype=onp.float32)
+    seq_lens = onp.array([13, 1, 30, 7], onp.int32)
+    block_tables = onp.zeros((S, B), onp.int32)
+    ids = iter(range(1, num_blocks))
+    for s in range(S):
+        for j in range((seq_lens[s] + bs - 1) // bs):
+            block_tables[s, j] = next(ids)
+    token_seq_ids = onp.arange(S, dtype=onp.int32)
+    positions = seq_lens - 1
+    token_qpos = onp.zeros(S, onp.int32)
+    qtok_idx = onp.arange(S, dtype=onp.int32).reshape(S, 1)
+
+    args = [jnp.asarray(x) for x in (
+        q, k_cache, v_cache, token_seq_ids, positions.astype(onp.int32),
+        block_tables, seq_lens)]
+    ref = ragged_paged_attention_reference(*args, block_size=bs)
+    got = ragged_paged_attention_chunked(
+        *args, qtok_idx=jnp.asarray(qtok_idx),
+        token_qpos=jnp.asarray(token_qpos), block_size=bs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

@@ -1,0 +1,143 @@
+"""OpenAI server contract: probes, metrics taxonomy, completions, streaming.
+
+Runs the real aiohttp app (tiny model on CPU) in a background thread and
+talks to it over real HTTP — the same surface Envoy/EPP would see.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+import requests
+
+from llm_d_tpu.engine.engine import EngineConfig
+from llm_d_tpu.server.openai import build_server
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    import asyncio
+    from aiohttp import web
+
+    port = free_port()
+    cfg = EngineConfig(model="tiny", block_size=4, num_blocks=64,
+                       max_num_seqs=8, max_num_batched_tokens=64,
+                       min_token_bucket=16, min_seq_bucket=4)
+    server = build_server(cfg)
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    url = f"http://127.0.0.1:{port}"
+    # three-probe contract: wait for readiness via /v1/models
+    for _ in range(100):
+        try:
+            if requests.get(url + "/v1/models", timeout=5).status_code == 200:
+                break
+        except requests.ConnectionError:
+            pass
+        time.sleep(0.1)
+    return url
+
+
+def test_probes(server_url):
+    assert requests.get(server_url + "/health").status_code == 200
+    r = requests.get(server_url + "/v1/models")
+    assert r.status_code == 200
+    assert r.json()["data"][0]["id"] == "tiny"
+    assert requests.get(server_url + "/version").status_code == 200
+
+
+def test_metrics_taxonomy(server_url):
+    text = requests.get(server_url + "/metrics").text
+    for name in ["vllm:kv_cache_usage_perc", "vllm:num_requests_waiting",
+                 "vllm:num_requests_running", "vllm:time_to_first_token_seconds",
+                 "vllm:prefix_cache_queries", "vllm:generation_tokens"]:
+        assert name in text, f"missing metric {name}"
+
+
+def test_completion(server_url):
+    r = requests.post(server_url + "/v1/completions", json={
+        "model": "tiny", "prompt": "hello", "max_tokens": 4,
+        "temperature": 0.0, "ignore_eos": True})
+    assert r.status_code == 200
+    body = r.json()
+    assert body["usage"]["completion_tokens"] == 4
+    assert body["choices"][0]["finish_reason"] == "length"
+
+
+def test_completion_token_ids_prompt(server_url):
+    r = requests.post(server_url + "/v1/completions", json={
+        "model": "tiny", "prompt": [1, 2, 3, 4], "max_tokens": 3,
+        "temperature": 0.0, "ignore_eos": True})
+    assert r.status_code == 200
+    assert r.json()["usage"]["prompt_tokens"] == 4
+
+
+def test_streaming(server_url):
+    r = requests.post(server_url + "/v1/completions", json={
+        "model": "tiny", "prompt": "stream me", "max_tokens": 4,
+        "temperature": 0.0, "ignore_eos": True, "stream": True}, stream=True)
+    assert r.status_code == 200
+    events = []
+    for line in r.iter_lines():
+        if line.startswith(b"data: "):
+            payload = line[len(b"data: "):]
+            if payload == b"[DONE]":
+                events.append("DONE")
+            else:
+                events.append(json.loads(payload))
+    assert events[-1] == "DONE"
+    assert len(events) == 5          # 4 tokens + DONE
+    assert events[-2]["choices"][0]["finish_reason"] == "length"
+
+
+def test_chat_completion(server_url):
+    r = requests.post(server_url + "/v1/chat/completions", json={
+        "model": "tiny",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 3, "temperature": 0.0, "ignore_eos": True})
+    assert r.status_code == 200
+    body = r.json()
+    assert body["object"] == "chat.completion"
+    assert "content" in body["choices"][0]["message"]
+
+
+def test_concurrent_load_and_metrics_progress(server_url):
+    def fire():
+        requests.post(server_url + "/v1/completions", json={
+            "model": "tiny", "prompt": "load", "max_tokens": 8,
+            "temperature": 0.0, "ignore_eos": True})
+    threads = [threading.Thread(target=fire) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    text = requests.get(server_url + "/metrics").text
+    for line in text.splitlines():
+        if line.startswith("vllm:generation_tokens_total"):
+            assert float(line.rsplit(" ", 1)[1]) >= 8 * 8
+            break
+    else:
+        pytest.fail("generation_tokens metric missing")
