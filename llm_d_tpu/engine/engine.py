@@ -120,6 +120,10 @@ class EngineCore:
         # Optional KV connector (set by the server / PD wiring).
         self.kv_connector = None
         self.eos_token_id: Optional[int] = None
+        # Optional tokenizer enables engine-side stop-string detection (the
+        # server sets it; without one, stop strings fall back to server-side
+        # truncation only).
+        self.tokenizer = None
         self._last_evictions = 0
         self._last_preemptions = 0
 
@@ -141,7 +145,8 @@ class EngineCore:
                 params, kv_cache, batch, c, block_size, backend)
             logits = llama.compute_logits(params, hidden, c)
             ids = sampling_ops.sample(
-                logits, batch["temperature"], batch["top_k"], batch["top_p"], rng)
+                logits, batch["temperature"], batch["top_k"], batch["top_p"],
+                rng, seeds=batch["seeds"], gen_idx=batch["gen_idx"])
             logprobs = sampling_ops.compute_logprobs(logits, ids)
             return ids, logprobs, kv_cache
 
@@ -159,7 +164,8 @@ class EngineCore:
             S = mbatch["last_ids"].shape[0]
             bt = mbatch["block_tables"]
 
-            def one_iter(carry, key):
+            def one_iter(carry, xs):
+                key, it = xs
                 kv_cache, last_ids, pos0 = carry
                 # Decode batch: T == S, one token per sequence.
                 slot = (jnp.take_along_axis(
@@ -182,14 +188,16 @@ class EngineCore:
                 logits = llama.compute_logits(params, hidden, c)
                 ids = sampling_ops.sample(
                     logits, mbatch["temperature"], mbatch["top_k"],
-                    mbatch["top_p"], key)
+                    mbatch["top_p"], key, seeds=mbatch["seeds"],
+                    gen_idx=mbatch["gen0"] + it)
                 ids = jnp.where(mbatch["active"], ids, 0)
                 return (kv_cache, ids, pos0 + 1), ids
 
             keys = jax.random.split(rng, K)
             (kv_cache, _, _), ids_ks = jax.lax.scan(
                 one_iter, (kv_cache, mbatch["last_ids"],
-                           mbatch["pos0"]), keys)
+                           mbatch["pos0"]),
+                (keys, jnp.arange(K, dtype=jnp.int32)))
             return ids_ks, kv_cache   # [K, S]
 
         return multistep_fn
@@ -234,6 +242,8 @@ class EngineCore:
         temperature = np.zeros(S, np.float32)
         top_k = np.zeros(S, np.int32)
         top_p = np.ones(S, np.float32)
+        seeds = np.full(S, -1, np.int32)
+        gen0 = np.zeros(S, np.int32)
         for s, sr in enumerate(sched.scheduled):
             req = sr.request
             last_ids[s] = req.all_token_ids[req.num_computed_tokens]
@@ -243,13 +253,19 @@ class EngineCore:
             temperature[s] = req.sampling.temperature
             top_k[s] = req.sampling.top_k
             top_p[s] = req.sampling.top_p
+            if req.sampling.seed is not None:
+                # Mask into int32: a 64-bit seed must not OverflowError the
+                # batch array (and kill the engine loop for the whole server).
+                seeds[s] = int(req.sampling.seed) & 0x7FFFFFFF
+            gen0[s] = len(req.output_token_ids)
 
         mbatch = jax.device_put(dict(
             last_ids=jnp.asarray(last_ids), pos0=jnp.asarray(pos0),
             block_tables=jnp.asarray(block_tables),
             active=jnp.asarray(active),
             temperature=jnp.asarray(temperature),
-            top_k=jnp.asarray(top_k), top_p=jnp.asarray(top_p)),
+            top_k=jnp.asarray(top_k), top_p=jnp.asarray(top_p),
+            seeds=jnp.asarray(seeds), gen0=jnp.asarray(gen0)),
             self._replicated)
         self._rng, step_key = jax.random.split(self._rng)
         ids_ks, self.kv_cache = self._multistep_fn(
@@ -302,7 +318,11 @@ class EngineCore:
 
     def abort_request(self, request_id: str) -> None:
         self.scheduler.abort_request(request_id)
-        self.pinned_transfers.pop(request_id, None)
+        # Aborting a finished remote-prefill (PD producer) must free the
+        # pinned blocks, or the usable cache shrinks permanently.
+        req = self.pinned_transfers.pop(request_id, None)
+        if req is not None:
+            self.kv_manager.free(req)
 
     def has_work(self) -> bool:
         return self.scheduler.has_work()
@@ -342,6 +362,8 @@ class EngineCore:
         temperature = np.zeros(S, np.float32)
         top_k = np.zeros(S, np.int32)
         top_p = np.ones(S, np.float32)
+        seeds = np.full(S, -1, np.int32)
+        gen_idx = np.zeros(S, np.int32)
 
         t = 0
         for s, sr in enumerate(out.scheduled):
@@ -365,6 +387,9 @@ class EngineCore:
             temperature[s] = sp.temperature
             top_k[s] = sp.top_k
             top_p[s] = sp.top_p
+            if sp.seed is not None:
+                seeds[s] = int(sp.seed) & 0x7FFFFFFF   # int32-safe (see above)
+            gen_idx[s] = len(req.output_token_ids)
             t += n
 
         batch_np = dict(
@@ -372,7 +397,8 @@ class EngineCore:
             token_seq_ids=token_seq_ids, token_qpos=token_qpos,
             slot_mapping=slot_mapping, block_tables=block_tables,
             seq_lens=seq_lens, sample_idx=sample_idx, qtok_idx=qtok_idx,
-            temperature=temperature, top_k=top_k, top_p=top_p)
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            seeds=seeds, gen_idx=gen_idx)
         batch = jax.device_put(batch_np, self._replicated)
         return batch, out.scheduled
 
@@ -471,6 +497,16 @@ class EngineCore:
                 and token == self.eos_token_id \
                 and len(req.output_token_ids) >= sp.min_tokens:
             return RequestState.FINISHED_STOPPED.value
+        # Engine-side stop strings: decode a tail window (a stop string can
+        # span token boundaries) and terminate generation promptly instead of
+        # decoding to max_tokens and truncating in the server.
+        if sp.stop and self.tokenizer is not None \
+                and len(req.output_token_ids) >= sp.min_tokens:
+            max_stop = max(len(s) for s in sp.stop)
+            window = req.output_token_ids[-(max_stop + 8):]
+            tail = self.tokenizer.decode(window)
+            if any(s in tail for s in sp.stop):
+                return RequestState.FINISHED_STOPPED.value
         if len(req.output_token_ids) >= sp.max_tokens:
             return RequestState.FINISHED_LENGTH.value
         if req.num_tokens >= self.model_config.max_model_len:

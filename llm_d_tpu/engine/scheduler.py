@@ -85,10 +85,16 @@ class Scheduler:
 
     # ---------- core ----------
 
-    def _preempt_for(self, needy: Request, preempted_now: set) -> bool:
-        """Preempt the most recent running request other than ``needy``."""
+    def _preempt_for(self, needy: Request, preempted_now: set,
+                     scheduled_ids: set) -> bool:
+        """Preempt the most recent running request other than ``needy``.
+
+        Requests already scheduled in this pass are not eligible victims:
+        freeing their blocks after they were appended to ``scheduled`` would
+        corrupt the batch the engine is about to build.
+        """
         for victim in reversed(self.running):
-            if victim is needy:
+            if victim is needy or victim.request_id in scheduled_ids:
                 continue
             self.running.remove(victim)
             self.kv.free(victim)
@@ -111,6 +117,7 @@ class Scheduler:
         preempted_now: set = set()
 
         # 1. Running requests (decodes and in-flight chunked prefills).
+        scheduled_ids: set = set()
         for req in list(self.running):
             if budget <= 0:
                 break
@@ -120,17 +127,46 @@ class Scheduler:
             if remaining <= 0:
                 remaining = 1       # decode: compute the next token's KV
             n = min(remaining, budget)
+            # Terminal path: a request whose block demand exceeds the whole
+            # pool can never run — fail it instead of livelocking with n=0
+            # forever (has_work() true, no progress, no client error).
+            needed = -(-(req.num_computed_tokens + n) // self.kv.block_size)
+            if needed > self.kv.num_blocks - 1:
+                self.running.remove(req)
+                self.kv.free(req)
+                req.state = RequestState.FINISHED_ABORTED
+                preempted.append(req)
+                continue
             while True:
                 ok = self.kv.allocate(req, req.num_computed_tokens + n)
                 if ok is not None:
                     break
-                if not self._preempt_for(req, preempted_now):
-                    n = 0           # cannot run this request at all this step
+                if self._preempt_for(req, preempted_now, scheduled_ids):
+                    continue
+                # Nothing to preempt: shrink the chunk to the blocks that are
+                # actually free so mid-prefill requests keep making progress
+                # (partial pools must not stall the pass).
+                fit = ((len(req.block_ids) + self.kv.num_free_blocks)
+                       * self.kv.block_size) - req.num_computed_tokens
+                if fit >= n:        # bookkeeping race; bail out of this req
+                    n = 0
+                    break
+                n = max(fit, 0)
+                if n <= 0:
                     break
             if n <= 0:
+                # Nothing schedulable and nothing preemptable: if no other
+                # request holds reclaimable blocks this will never resolve.
+                if not scheduled and len(self.running) == 1 \
+                        and not self.kv.can_allocate(1):
+                    self.running.remove(req)
+                    self.kv.free(req)
+                    req.state = RequestState.FINISHED_ABORTED
+                    preempted.append(req)
                 continue
             budget -= n
             scheduled.append(ScheduledRequest(req, n))
+            scheduled_ids.add(req.request_id)
 
         # 2. Waiting requests, FIFO within priority
         # (lower priority value = more important, matching InferenceObjective).
@@ -163,6 +199,13 @@ class Scheduler:
             ok = self.kv.allocate(req, req.num_computed_tokens + n, reuse)
             if ok is None:
                 req.num_computed_tokens = 0
+                # First chunk alone exceeding the whole pool can never be
+                # admitted — fail it rather than blocking the queue forever.
+                if -(-n // self.kv.block_size) > self.kv.num_blocks - 1:
+                    self.waiting.remove(req)
+                    req.state = RequestState.FINISHED_ABORTED
+                    preempted.append(req)
+                    continue
                 break               # head-of-line: don't skip ahead of FIFO
             self.waiting.remove(req)
             self.running.append(req)

@@ -39,21 +39,24 @@ _TRANSPOSE = {"q_proj", "k_proj", "v_proj", "o_proj",
 
 
 def _to_numpy(t: Any) -> np.ndarray:
-    """torch tensor / numpy array -> numpy (bf16 via uint16 view round-trip)."""
+    """torch tensor / numpy array -> numpy with true value semantics.
+
+    bf16 torch tensors round-trip through a uint16 bit view and are
+    reinterpreted as ``ml_dtypes.bfloat16`` so downstream float32 casts
+    convert *values*, not raw bit patterns.
+    """
+    import ml_dtypes
+
     if isinstance(t, np.ndarray):
+        if t.dtype == np.dtype("<u2"):     # raw bf16 bits (e.g. from safetensors)
+            return t.view(ml_dtypes.bfloat16)
         return t
     # torch tensor
     t = t.detach().cpu()
     if str(t.dtype) == "torch.bfloat16":
-        return t.view(dtype=__import__("torch").uint16).numpy().view("<u2")
+        import torch
+        return t.view(dtype=torch.uint16).numpy().view(ml_dtypes.bfloat16)
     return t.numpy()
-
-
-def _get(weights: Mapping[str, Any], name: str) -> np.ndarray:
-    arr = _to_numpy(weights[name])
-    if arr.dtype == np.dtype("<u2"):
-        arr = arr.view(jnp.bfloat16.dtype) if hasattr(jnp.bfloat16, "dtype") else arr
-    return arr
 
 
 def load_dense_from_state_dict(

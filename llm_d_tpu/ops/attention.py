@@ -242,12 +242,13 @@ def attention_with_kv_update(
         backend = "pallas" if jax.default_backend() == "tpu" else "reference"
 
     qtok_idx = batch.get("qtok_idx")
-    # TPU DMA slices need sublane-aligned pages: the Pallas kernel requires
-    # block_size % 16 == 0 (bf16 tiling); smaller block sizes fall back to
-    # the chunked XLA path instead of failing Mosaic compilation.
+    # TPU DMA slices need sublane- and lane-aligned pages: the Pallas kernel
+    # requires block_size % 16 == 0 (bf16 sublane tiling) AND a folded KV row
+    # width (KVH*D) that is a multiple of 128 lanes; anything smaller falls
+    # back to the chunked XLA path instead of failing Mosaic compilation.
     if backend == "pallas" and qtok_idx is not None \
             and qtok_idx.shape[1] == 1 and soft_cap is None \
-            and block_size % 16 == 0:
+            and block_size % 16 == 0 and k_cache.shape[1] % 128 == 0:
         from llm_d_tpu.ops.pallas.paged_attention import (
             paged_attention_decode_update)
         T, H, D = q.shape

@@ -44,10 +44,32 @@ def sample(
     top_k: jax.Array,         # [S] i32 (0 = off)
     top_p: jax.Array,         # [S] f32 (1 = off)
     key: jax.Array,           # PRNG key for this step
+    seeds: Optional[jax.Array] = None,     # [S] i32, -1 = unseeded
+    gen_idx: Optional[jax.Array] = None,   # [S] i32 tokens generated so far
 ) -> jax.Array:               # [S] i32 sampled token ids
+    """Batched sampling with per-request seeded reproducibility.
+
+    Rows with ``seeds[s] >= 0`` draw from ``fold_in(fold_in(zero_key,
+    seed), gen_idx)`` — deterministic for a given (seed, position)
+    regardless of batch composition or engine step count (the vLLM
+    ``SamplingParams.seed`` contract). Unseeded rows derive from the
+    engine's per-step key folded with the row index.
+    """
     S, V = logits.shape
     greedy_ids = jnp.argmax(logits, axis=-1)
     K = min(TOPK_MAX, V)
+
+    def row_keys():
+        rows = jnp.arange(S)
+        unseeded = jax.vmap(lambda i: jax.random.fold_in(key, i))(rows)
+        if seeds is None:
+            return unseeded
+        base = jax.random.PRNGKey(0)
+        gi = gen_idx if gen_idx is not None else jnp.zeros(S, jnp.int32)
+        seeded = jax.vmap(lambda s, g: jax.random.fold_in(
+            jax.random.fold_in(base, jnp.maximum(s, 0)), g))(seeds, gi)
+        pick = (seeds >= 0)[:, None]
+        return jnp.where(pick, seeded, unseeded)
 
     def do_sample(_):
         vals, idxs = jax.lax.top_k(logits, K)                # [S, K]
@@ -62,7 +84,8 @@ def sample(
         # always survives.
         keep_p = (cum - probs) < top_p[:, None]
         masked = jnp.where(keep_k & keep_p, v, -jnp.inf)
-        gumbel = jax.random.gumbel(key, masked.shape, dtype=jnp.float32)
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(k, (K,), jnp.float32))(row_keys())
         choice = jnp.argmax(masked + gumbel, axis=-1)        # [S]
         return jnp.take_along_axis(idxs, choice[:, None], axis=-1)[:, 0]
 

@@ -66,6 +66,8 @@ def make_mesh(
     devices = list(devices if devices is not None else jax.devices())
     if config is None:
         config = MeshConfig(tp=len(devices))
+    if config.num_devices < len(devices):
+        devices = devices[:config.num_devices]
     if config.num_devices != len(devices):
         raise ValueError(
             f"mesh {config} needs {config.num_devices} devices, got {len(devices)}")

@@ -62,6 +62,9 @@ class ModelServer:
         self.started_at = time.time()
         if tokenizer.eos_token_id is not None:
             engine.eos_token_id = tokenizer.eos_token_id
+        # Engine-side stop-string detection (finish_reason="stop" without
+        # decoding to max_tokens first).
+        engine.tokenizer = tokenizer
 
     # ---------- app ----------
 
@@ -179,10 +182,17 @@ class ModelServer:
                 text = self.tokenizer.decode(req.output_token_ids)
                 delta, all_text_len = text[all_text_len:], len(text)
                 delta, stopped = self._apply_stop_strings(req, delta, text)
-                chunk = self._chunk(req, delta, out, created, chat)
+                finished = out.finished or stopped
+                reason = "stop" if stopped else out.finish_reason
+                chunk = self._chunk(req, delta, out, created, chat,
+                                    finished=finished, finish_reason=reason)
                 await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
-                if stopped:
+                if stopped and not out.finished:
+                    # Safety net: the engine missed the stop string (e.g. it
+                    # spanned a longer window); terminate and settle accounts.
                     self.engine.abort_request(req.request_id)
+                    break
+                if finished:
                     break
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
@@ -192,7 +202,10 @@ class ModelServer:
         async for out in self.async_engine.generate(req):
             final_out = out
         text = self.tokenizer.decode(req.output_token_ids)
-        text, _ = self._apply_stop_strings(req, text, text)
+        text, stopped = self._apply_stop_strings(req, text, text)
+        finish_reason = final_out.finish_reason if final_out else None
+        if stopped:
+            finish_reason = "stop"
         payload = {
             "id": req.request_id,
             "object": "chat.completion" if chat else "text_completion",
@@ -200,7 +213,7 @@ class ModelServer:
             "model": self.model_name,
             "choices": [{
                 "index": 0,
-                "finish_reason": final_out.finish_reason if final_out else None,
+                "finish_reason": finish_reason,
                 **({"message": {"role": "assistant", "content": text}}
                    if chat else {"text": text}),
             }],
@@ -223,10 +236,11 @@ class ModelServer:
                 return (full[delta_start:idx] if idx > delta_start else ""), True
         return delta, False
 
-    def _chunk(self, req, delta: str, out, created: int, chat: bool):
+    def _chunk(self, req, delta: str, out, created: int, chat: bool,
+               finished: bool, finish_reason: Optional[str]):
         choice: Dict[str, Any] = {
             "index": 0,
-            "finish_reason": out.finish_reason if out.finished else None}
+            "finish_reason": finish_reason if finished else None}
         if chat:
             choice["delta"] = {"content": delta}
         else:
