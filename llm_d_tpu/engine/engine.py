@@ -28,7 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from llm_d_tpu.engine.kv_cache import KVCacheManager
 from llm_d_tpu.engine.request import Request, RequestOutput, RequestState
 from llm_d_tpu.engine.scheduler import Scheduler, SchedulerOutput
-from llm_d_tpu.models import llama
+from llm_d_tpu.models import get_model
 from llm_d_tpu.models.config import ModelConfig, get_config
 from llm_d_tpu.ops import sampling as sampling_ops
 from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -93,9 +93,10 @@ class EngineCore:
         self.metrics = metrics or EngineMetrics(c.name)
 
         # --- device state ---
-        rules = llama.sharding_rules(c)
+        self.model = get_model(c)       # models.llama (dense) or models.moe
+        rules = self.model.sharding_rules(c)
         if params is None:
-            params = llama.init_params(c, jax.random.PRNGKey(config.seed))
+            params = self.model.init_params(c, jax.random.PRNGKey(config.seed))
         shardings = logical_to_sharding(rules, params, self.mesh)
         self.params = shard_pytree(params, shardings)
 
@@ -105,7 +106,7 @@ class EngineCore:
         kv_shape = (c.num_layers, num_slots, c.num_kv_heads * c.head_dim_)
         kv_sharding = {
             k: NamedSharding(self.mesh, spec)
-            for k, spec in llama.kv_cache_spec().items()}
+            for k, spec in self.model.kv_cache_spec().items()}
         self.kv_cache = {
             k: jax.device_put(jnp.zeros(kv_shape, jnp.bfloat16), kv_sharding[k])
             for k in ("k", "v")}
@@ -138,12 +139,13 @@ class EngineCore:
         c = self.model_config
         block_size = self.config.block_size
         backend = self.config.attn_backend
+        model, mesh = self.model, self.mesh
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def step_fn(params, kv_cache, batch, rng):
-            hidden, kv_cache = llama.forward(
-                params, kv_cache, batch, c, block_size, backend)
-            logits = llama.compute_logits(params, hidden, c)
+            hidden, kv_cache = model.forward(
+                params, kv_cache, batch, c, block_size, backend, mesh=mesh)
+            logits = model.compute_logits(params, hidden, c)
             ids = sampling_ops.sample(
                 logits, batch["temperature"], batch["top_k"], batch["top_p"],
                 rng, seeds=batch["seeds"], gen_idx=batch["gen_idx"])
@@ -158,6 +160,7 @@ class EngineCore:
         c = self.model_config
         block_size = self.config.block_size
         backend = self.config.attn_backend
+        model, mesh = self.model, self.mesh
 
         @functools.partial(jax.jit, static_argnums=(), donate_argnums=(1,))
         def multistep_fn(params, kv_cache, mbatch, rng):
@@ -183,9 +186,9 @@ class EngineCore:
                     sample_idx=jnp.arange(S, dtype=jnp.int32),
                     qtok_idx=jnp.arange(S, dtype=jnp.int32)[:, None],
                 )
-                hidden, kv_cache = llama.forward(
-                    params, kv_cache, batch, c, block_size, backend)
-                logits = llama.compute_logits(params, hidden, c)
+                hidden, kv_cache = model.forward(
+                    params, kv_cache, batch, c, block_size, backend, mesh=mesh)
+                logits = model.compute_logits(params, hidden, c)
                 ids = sampling_ops.sample(
                     logits, mbatch["temperature"], mbatch["top_k"],
                     mbatch["top_p"], key, seeds=mbatch["seeds"],

@@ -1,3 +1,15 @@
 from llm_d_tpu.models.config import ModelConfig, PRESETS, get_config
 
-__all__ = ["ModelConfig", "PRESETS", "get_config"]
+
+def get_model(config: ModelConfig):
+    """Model module for a config: ``models.moe`` for MoE configs
+    (num_experts > 0), ``models.llama`` for dense.  Each module exposes
+    init_params / forward / compute_logits / sharding_rules / kv_cache_spec."""
+    if config.is_moe:
+        from llm_d_tpu.models import moe
+        return moe
+    from llm_d_tpu.models import llama
+    return llama
+
+
+__all__ = ["ModelConfig", "PRESETS", "get_config", "get_model"]

@@ -104,6 +104,7 @@ def forward(
     config: ModelConfig,
     block_size: int,
     attn_backend: str = "auto",
+    mesh=None,                        # unused (MoE models need it for EP)
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One engine step over a ragged batch.
 

@@ -12,6 +12,7 @@ import json
 import os
 from typing import Any, Callable, Dict, Iterable, Mapping, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -92,6 +93,100 @@ def load_dense_from_state_dict(
     if not c.tie_word_embeddings:
         head = arr("lm_head.weight").T
         params["lm_head"] = jnp.asarray(head, dt)
+    return params
+
+
+_ATTN_KEYS = ("input_norm", "q_proj", "k_proj", "v_proj", "o_proj",
+              "q_bias", "k_bias", "v_bias", "q_norm", "k_norm",
+              "post_attn_norm")
+_MLP_KEYS = ("gate_proj", "up_proj", "down_proj")
+
+
+def load_moe_from_state_dict(
+    config: ModelConfig,
+    weights: Mapping[str, Any],
+    prefix: str = "model.",
+) -> Dict[str, Any]:
+    """MoE checkpoint (DeepSeek-V3 / Qwen-MoE naming) -> two-group stacked
+    tree (``models.moe`` layout: ``dense_layers`` then ``moe_layers``).
+
+    HF names: router ``mlp.gate.weight``, experts
+    ``mlp.experts.{e}.{gate,up,down}_proj.weight``, shared experts
+    ``mlp.shared_experts.*`` (DeepSeek) / ``mlp.shared_expert.*`` (Qwen).
+    """
+    c = config
+    dt = c.jax_dtype
+    Ld = c.first_dense_layers
+
+    def arr(name):
+        return np.asarray(_to_numpy(weights[name]), dtype=np.float32)
+
+    def stack(names, transpose):
+        ws = [arr(n) for n in names]
+        if transpose:
+            ws = [w.T for w in ws]
+        return jnp.asarray(np.stack(ws) if ws else
+                           np.zeros((0,)), dt)
+
+    params: Dict[str, Any] = {
+        "embed": jnp.asarray(arr(f"{prefix}embed_tokens.weight"), dt),
+        "final_norm": jnp.asarray(arr(f"{prefix}norm.weight"), dt),
+        "dense_layers": {}, "moe_layers": {},
+    }
+
+    def fill_attn(group: Dict, layer_ids):
+        for ours in _ATTN_KEYS:
+            hf_suffix = _LAYER_MAP[ours]
+            if f"{prefix}layers.{layer_ids[0]}.{hf_suffix}" not in weights:
+                continue
+            group[ours] = stack(
+                [f"{prefix}layers.{li}.{hf_suffix}" for li in layer_ids],
+                ours in _TRANSPOSE)
+
+    dense_ids = list(range(Ld))
+    moe_ids = list(range(Ld, c.num_layers))
+    if dense_ids:
+        fill_attn(params["dense_layers"], dense_ids)
+        for ours in _MLP_KEYS:
+            params["dense_layers"][ours] = stack(
+                [f"{prefix}layers.{li}.{_LAYER_MAP[ours]}"
+                 for li in dense_ids], True)
+    else:
+        # first_dense_layers == 0 (e.g. Mixtral): the scan body still traces,
+        # so the group needs its full key structure with 0-length leading
+        # dims — borrow it from init_params' shapes.
+        from llm_d_tpu.models import moe as moe_model
+        shapes = jax.eval_shape(
+            lambda k: moe_model.init_params(c, k), jax.random.PRNGKey(0))
+        params["dense_layers"] = {
+            k: jnp.zeros(s.shape, s.dtype)
+            for k, s in shapes["dense_layers"].items()}
+
+    fill_attn(params["moe_layers"], moe_ids)
+    m = params["moe_layers"]
+    m["router"] = jnp.asarray(np.stack(
+        [arr(f"{prefix}layers.{li}.mlp.gate.weight").T for li in moe_ids]),
+        jnp.float32)
+    for ours, hf in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
+                     ("w_down", "down_proj")):
+        m[ours] = jnp.asarray(np.stack([
+            np.stack([arr(f"{prefix}layers.{li}.mlp.experts.{e}.{hf}.weight").T
+                      for e in range(c.num_experts)])
+            for li in moe_ids]), dt)
+    shared_prefix = None
+    for cand in ("mlp.shared_experts", "mlp.shared_expert"):
+        if f"{prefix}layers.{moe_ids[0]}.{cand}.gate_proj.weight" in weights:
+            shared_prefix = cand
+            break
+    if shared_prefix is not None:
+        for ours, hf in (("shared_gate", "gate_proj"),
+                         ("shared_up", "up_proj"),
+                         ("shared_down", "down_proj")):
+            m[ours] = jnp.asarray(np.stack(
+                [arr(f"{prefix}layers.{li}.{shared_prefix}.{hf}.weight").T
+                 for li in moe_ids]), dt)
+    if not c.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(arr("lm_head.weight").T, dt)
     return params
 
 

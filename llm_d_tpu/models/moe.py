@@ -1,0 +1,179 @@
+"""MoE decoder-only transformer (Mixtral / DeepSeek-V3 families).
+
+Same functional design as ``models.llama`` (stacked layers + ``lax.scan``)
+with the MLP replaced by shared + routed experts.  DeepSeek-style models run
+their first ``first_dense_layers`` layers dense, so the stack scans two
+parameter groups: ``dense_layers`` then ``moe_layers`` (the KV cache is one
+[L, slots, KVH*D] buffer split at the boundary).
+
+This is the model half of the wide-EP path (reference:
+guides/wide-ep-lws/manifests/modelserver/base/decode.yaml:76-132 — EP flags,
+EPLB, DeepEP backends; the engine equivalents live in ``ops.moe``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llm_d_tpu.models.config import ModelConfig
+from llm_d_tpu.models.llama import (
+    attention_block, compute_logits)   # noqa: F401  (compute_logits re-export)
+from llm_d_tpu.ops import layers as L
+from llm_d_tpu.ops import moe as moe_ops
+from llm_d_tpu.parallel.mesh import AXIS_EP
+
+Params = Dict[str, Any]
+
+
+def _attn_params(c: ModelConfig, n: int, key, dt) -> Params:
+    dh = c.head_dim_
+    k = iter(jax.random.split(key, 8))
+
+    def stacked(shape, kk):
+        return (jax.random.normal(kk, (n, *shape), jnp.float32)
+                * (shape[0] ** -0.5)).astype(dt)
+
+    p = {
+        "input_norm": jnp.ones((n, c.hidden_size), dt),
+        "q_proj": stacked((c.hidden_size, c.num_heads * dh), next(k)),
+        "k_proj": stacked((c.hidden_size, c.num_kv_heads * dh), next(k)),
+        "v_proj": stacked((c.hidden_size, c.num_kv_heads * dh), next(k)),
+        "o_proj": stacked((c.num_heads * dh, c.hidden_size), next(k)),
+        "post_attn_norm": jnp.ones((n, c.hidden_size), dt),
+    }
+    if c.attention_bias:
+        p["q_bias"] = jnp.zeros((n, c.num_heads * dh), dt)
+        p["k_bias"] = jnp.zeros((n, c.num_kv_heads * dh), dt)
+        p["v_bias"] = jnp.zeros((n, c.num_kv_heads * dh), dt)
+    if c.qk_norm:
+        p["q_norm"] = jnp.ones((n, dh), dt)
+        p["k_norm"] = jnp.ones((n, dh), dt)
+    return p
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    c = config
+    dt = c.jax_dtype
+    Ld = c.first_dense_layers
+    Lm = c.num_layers - Ld
+    E, Im = c.num_experts, c.moe_intermediate_size
+    Ish = Im * c.num_shared_experts
+    k = iter(jax.random.split(key, 16))
+
+    def w(shape, kk):
+        return (jax.random.normal(kk, shape, jnp.float32)
+                * (shape[-2] ** -0.5)).astype(dt)
+
+    dense = _attn_params(c, Ld, next(k), dt)
+    dense.update({
+        "gate_proj": w((Ld, c.hidden_size, c.intermediate_size), next(k)),
+        "up_proj": w((Ld, c.hidden_size, c.intermediate_size), next(k)),
+        "down_proj": w((Ld, c.intermediate_size, c.hidden_size), next(k)),
+    })
+    moe = _attn_params(c, Lm, next(k), dt)
+    moe.update({
+        "router": w((Lm, c.hidden_size, E), next(k)).astype(jnp.float32),
+        "w_gate": w((Lm, E, c.hidden_size, Im), next(k)),
+        "w_up": w((Lm, E, c.hidden_size, Im), next(k)),
+        "w_down": w((Lm, E, Im, c.hidden_size), next(k)),
+    })
+    if c.num_shared_experts > 0:
+        moe.update({
+            "shared_gate": w((Lm, c.hidden_size, Ish), next(k)),
+            "shared_up": w((Lm, c.hidden_size, Ish), next(k)),
+            "shared_down": w((Lm, Ish, c.hidden_size), next(k)),
+        })
+    params: Params = {
+        "embed": w((c.vocab_size, c.hidden_size), next(k)),
+        "dense_layers": dense,
+        "moe_layers": moe,
+        "final_norm": jnp.ones((c.hidden_size,), dt),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = w((c.hidden_size, c.vocab_size), next(k))
+    return params
+
+
+def forward(
+    params: Params,
+    kv_cache: Dict[str, jax.Array],   # {"k","v": [L, slots, KVH*dh]}
+    batch: Dict[str, jax.Array],
+    config: ModelConfig,
+    block_size: int,
+    attn_backend: str = "auto",
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    c = config
+    Ld = c.first_dense_layers
+    x = params["embed"][batch["token_ids"]]
+
+    def dense_body(carry, xs):
+        h = carry
+        lp, k_l, v_l = xs
+        a, k_l, v_l = attention_block(
+            lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
+            batch, k_l, v_l, block_size, attn_backend)
+        h = h + a
+        m = L.swiglu_mlp(
+            L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps),
+            lp["gate_proj"], lp["up_proj"], lp["down_proj"])
+        return h + m, (k_l, v_l)
+
+    def moe_body(carry, xs):
+        h = carry
+        lp, k_l, v_l = xs
+        a, k_l, v_l = attention_block(
+            lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
+            batch, k_l, v_l, block_size, attn_backend)
+        h = h + a
+        hn = L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps)
+        weights, idx = moe_ops.route(
+            jnp.dot(hn.astype(jnp.float32), lp["router"]), c)
+        m = moe_ops.expert_ffn(
+            hn, weights, idx, lp["w_gate"], lp["w_up"], lp["w_down"],
+            mesh=mesh)
+        if "shared_gate" in lp:
+            m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
+                                 lp["shared_down"])
+        return h + m, (k_l, v_l)
+
+    k_d, k_m = kv_cache["k"][:Ld], kv_cache["k"][Ld:]
+    v_d, v_m = kv_cache["v"][:Ld], kv_cache["v"][Ld:]
+    x, (k_d, v_d) = jax.lax.scan(
+        dense_body, x, (params["dense_layers"], k_d, v_d))
+    x, (k_m, v_m) = jax.lax.scan(
+        moe_body, x, (params["moe_layers"], k_m, v_m))
+
+    x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    sample_hidden = x[batch["sample_idx"]]
+    return sample_hidden, {
+        "k": jnp.concatenate([k_d, k_m]),
+        "v": jnp.concatenate([v_d, v_m]),
+    }
+
+
+def sharding_rules(config: ModelConfig):
+    """TP for attention/shared experts (Megatron layout), EP over the
+    flattened (dp, sp, tp) axes for routed experts — the wide-EP regime
+    ("TPxDP in attention, EP in MoE layers"; reference decode.yaml:76,87)."""
+    return [
+        (r"embed", P(None, "tp")),
+        (r"layers/(q|k|v)_proj", P(None, None, "tp")),
+        (r"layers/(q|k|v)_bias", P(None, "tp")),
+        (r"layers/o_proj", P(None, "tp", None)),
+        (r"dense_layers/(gate|up)_proj", P(None, None, "tp")),
+        (r"dense_layers/down_proj", P(None, "tp", None)),
+        (r"moe_layers/router", P()),
+        (r"moe_layers/w_(gate|up|down)", P(None, AXIS_EP)),
+        (r"moe_layers/shared_(gate|up)", P(None, None, "tp")),
+        (r"moe_layers/shared_down", P(None, "tp", None)),
+        (r"lm_head", P(None, "tp")),
+    ]
+
+
+def kv_cache_spec() -> Dict[str, P]:
+    return {"k": P(None, None, "tp"), "v": P(None, None, "tp")}

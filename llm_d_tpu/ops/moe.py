@@ -1,0 +1,206 @@
+"""MoE ops: routing, grouped expert GEMM, expert-parallel dispatch.
+
+TPU-native counterpart of the reference's DeepEP (expert all-to-all) +
+DeepGEMM (grouped GEMM) CUDA stack (reference: docker/Dockerfile.cuda:51-56,
+wide-ep decode.yaml:76-132).  Design:
+
+  - Routing (incl. DeepSeek group-limited top-k) is a few tiny matmuls and
+    sorts — computed replicated on every device; only expert FFNs shard.
+  - Grouped GEMM: tokens are sorted by expert id and fed to
+    ``jax.lax.ragged_dot`` — one MXU-friendly kernel over all local experts
+    instead of a Python loop (the DeepGEMM role).
+  - Expert parallelism: experts shard over the *flattened* (dp, sp, tp) mesh
+    axes ("TPxDP in attention, EP in MoE layers", decode.yaml:76,87).  Each
+    shard computes its local experts for every token (tokens are replicated
+    in the serving engine) and contributions combine with one ``psum`` over
+    ICI — the all-to-all dispatch/combine collapses into zero-padded
+    scatter-add + psum, which XLA schedules over ICI without NVSHMEM-style
+    bootstrap.  A ragged-all-to-all dispatch path is the planned upgrade for
+    DP-sharded activations (tracked with the DBO work).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llm_d_tpu.models.config import ModelConfig
+from llm_d_tpu.parallel.mesh import AXIS_EP
+
+
+def route(
+    router_logits: jax.Array,      # [T, E] f32
+    config: ModelConfig,
+) -> Tuple[jax.Array, jax.Array]:  # (weights [T, k] f32, idx [T, k] i32)
+    """Top-k expert selection with optional DeepSeek group-limited routing.
+
+    With ``n_group > 0`` the expert set is partitioned into groups; only the
+    ``topk_group`` groups with the highest (sum of top-2 member scores) stay
+    eligible — the device-locality trick DeepSeek-V3 uses so each token's
+    experts land on few nodes (reference wide-EP deploys DeepSeek-R1 with
+    this scheme; decode.yaml:76-132).
+    """
+    c = config
+    T, E = router_logits.shape
+    k = c.num_experts_per_tok
+    scores = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    if c.n_group > 0:
+        g = c.n_group
+        gs = scores.reshape(T, g, E // g)
+        # Group score: sum of each group's top-2 expert scores (V3 scheme).
+        top2 = jax.lax.top_k(gs, min(2, E // g))[0].sum(-1)     # [T, g]
+        _, keep = jax.lax.top_k(top2, c.topk_group)             # [T, topk_group]
+        mask = jnp.zeros((T, g), bool).at[
+            jnp.arange(T)[:, None], keep].set(True)
+        scores = jnp.where(
+            jnp.repeat(mask, E // g, axis=1), scores, 0.0)
+
+    weights, idx = jax.lax.top_k(scores, k)                     # [T, k]
+    if c.moe_renormalize:
+        weights = weights / jnp.maximum(
+            weights.sum(-1, keepdims=True), 1e-20)
+    weights = weights * c.routed_scaling_factor
+    return weights.astype(jnp.float32), idx.astype(jnp.int32)
+
+
+def _swiglu_grouped(xs, w_gate, w_up, w_down, group_sizes):
+    """SwiGLU through three grouped GEMMs (per-expert weights)."""
+    h = jax.lax.ragged_dot(xs, w_gate, group_sizes,
+                           preferred_element_type=jnp.float32)
+    u = jax.lax.ragged_dot(xs, w_up, group_sizes,
+                           preferred_element_type=jnp.float32)
+    a = (jax.nn.silu(h) * u).astype(xs.dtype)
+    return jax.lax.ragged_dot(a, w_down, group_sizes,
+                              preferred_element_type=jnp.float32)
+
+
+def _local_expert_ffn(
+    x: jax.Array,          # [T, H] all tokens (replicated per shard)
+    weights: jax.Array,    # [T, k] combine weights
+    idx: jax.Array,        # [T, k] global expert ids
+    w_gate: jax.Array,     # [E_loc, H, I]
+    w_up: jax.Array,
+    w_down: jax.Array,     # [E_loc, I, H]
+    e0: jax.Array,         # scalar: first global expert id on this shard
+) -> jax.Array:            # [T, H] partial output (only local experts)
+    """Sorted grouped-GEMM over this shard's experts; non-local slots are
+    routed to a trailing zero-weight trash group (static shapes, no drops)."""
+    T, H = x.shape
+    k = idx.shape[1]
+    E_loc = w_gate.shape[0]
+    S = T * k
+
+    flat = idx.reshape(S)
+    lid = flat - e0
+    is_local = (lid >= 0) & (lid < E_loc)
+    sort_key = jnp.where(is_local, lid, E_loc)
+    order = jnp.argsort(sort_key, stable=True)                  # [S]
+    tok = order // k
+    xs = x[tok]                                                 # [S, H]
+
+    counts = jnp.zeros(E_loc, jnp.int32).at[
+        jnp.clip(lid, 0, E_loc - 1)].add(is_local.astype(jnp.int32))
+    trash = S - counts.sum()
+    group_sizes = jnp.concatenate([counts, trash[None]])        # [E_loc+1]
+
+    zpad = jnp.zeros((1,) + w_gate.shape[1:], w_gate.dtype)
+    y = _swiglu_grouped(
+        xs,
+        jnp.concatenate([w_gate, zpad]),
+        jnp.concatenate([w_up, zpad]),
+        jnp.concatenate([w_down, jnp.zeros((1,) + w_down.shape[1:],
+                                           w_down.dtype)]),
+        group_sizes)                                            # [S, H] f32
+
+    wslot = (weights.reshape(S)[order]
+             * is_local[order].astype(jnp.float32))[:, None]
+    out = jnp.zeros((T, H), jnp.float32).at[tok].add(y * wslot)
+    return out
+
+
+def expert_ffn(
+    x: jax.Array,          # [T, H]
+    weights: jax.Array,    # [T, k]
+    idx: jax.Array,        # [T, k]
+    w_gate: jax.Array,     # [E, H, I] (sharded over EP when mesh given)
+    w_up: jax.Array,
+    w_down: jax.Array,     # [E, I, H]
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:            # [T, H] in x.dtype
+    """Routed-expert FFN, expert-parallel over the flattened mesh.
+
+    Single-device: one grouped GEMM over all experts.  Multi-device: each EP
+    shard runs the grouped GEMM for its expert slice and partial outputs
+    psum over ICI (see module docstring for the dispatch design).
+    """
+    if mesh is None or mesh.devices.size == 1:
+        out = _local_expert_ffn(
+            x, weights, idx, w_gate, w_up, w_down, jnp.int32(0))
+        return out.astype(x.dtype)
+
+    E = w_gate.shape[0]
+    ep = mesh.devices.size
+    E_loc = E // ep
+
+    sizes = [mesh.shape[a] for a in AXIS_EP]
+
+    def shard_body(x, weights, idx, w_gate, w_up, w_down):
+        ep_rank = jnp.int32(0)
+        for a, s in zip(AXIS_EP, sizes):
+            ep_rank = ep_rank * s + jax.lax.axis_index(a)
+        out = _local_expert_ffn(
+            x, weights, idx, w_gate, w_up, w_down, ep_rank * E_loc)
+        return jax.lax.psum(out, AXIS_EP)
+
+    out = jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(AXIS_EP), P(AXIS_EP), P(AXIS_EP)),
+        out_specs=P(),
+        check_vma=False,
+    )(x, weights, idx, w_gate, w_up, w_down)
+    return out.astype(x.dtype)
+
+
+def to_physical_experts(
+    idx: jax.Array,            # [T, k] logical expert ids
+    replica_table: jax.Array,  # [E, max_r] physical slots per logical expert
+    num_replicas: jax.Array,   # [E]
+) -> jax.Array:                # [T, k] physical expert ids
+    """Map routed logical experts to EPLB physical replicas.
+
+    Replica choice is round-robin over the (token, slot) index — load spreads
+    across a hot expert's replicas without any cross-token coordination (the
+    dispatch stays embarrassingly parallel).  Used with
+    ``parallel.eplb.plan_placement`` + ``gather_physical``.
+    """
+    T, k = idx.shape
+    slot = jnp.arange(T * k, dtype=jnp.int32).reshape(T, k)
+    r = slot % num_replicas[idx]
+    return replica_table[idx, r]
+
+
+def moe_ffn_reference(
+    x: jax.Array,
+    router_w: jax.Array,   # [H, E]
+    w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array,
+    config: ModelConfig,
+) -> jax.Array:
+    """Dense-dispatch oracle: every expert computed for every token, combined
+    with the routing weights.  O(T*E) FLOPs — tests only."""
+    weights, idx = route(
+        jnp.dot(x.astype(jnp.float32), router_w.astype(jnp.float32)), config)
+    T, k = idx.shape
+    E = w_gate.shape[0]
+    comb = jnp.zeros((T, E), jnp.float32).at[
+        jnp.arange(T)[:, None], idx].add(weights)
+    xf = x.astype(jnp.float32)
+    h = jnp.einsum("th,ehi->tei", xf, w_gate.astype(jnp.float32))
+    u = jnp.einsum("th,ehi->tei", xf, w_up.astype(jnp.float32))
+    y = jnp.einsum("tei,eih->teh", jax.nn.silu(h) * u,
+                   w_down.astype(jnp.float32))
+    return jnp.einsum("te,teh->th", comb, y).astype(x.dtype)

@@ -1,0 +1,150 @@
+"""EPLB: expert-parallel load balancing with redundant experts.
+
+The reference enables this via ``--enable-eplb --eplb-config '{"window_size":
+1000, "step_interval": 3000, "num_redundant_experts": 32, ...}'`` (reference:
+guides/wide-ep-lws/manifests/modelserver/base/decode.yaml:79,100-104): hot
+experts get extra physical replicas so per-device work evens out, with the
+divisibility constraint (E + redundant) % n_devices == 0.
+
+TPU translation: the *physical* expert table is what shards over the EP axis
+(``ops.moe.expert_ffn``); this module plans which logical expert occupies
+each physical slot from observed load, and the engine applies a new plan by
+re-gathering expert weights (an async device-to-device copy — no NVSHMEM
+re-registration, one of the places the TPU stack is simpler than the
+reference's).
+
+Plan algorithm (greedy, deterministic):
+  1. replicas per logical expert ∝ load (largest-remainder rounding, every
+     expert gets ≥ 1);
+  2. physical slots pack onto shards with longest-processing-time binning
+     under the fixed slots-per-shard capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class EplbPlan:
+    num_logical: int
+    phys_to_logical: np.ndarray      # [P] i32: physical slot -> logical expert
+    replica_table: np.ndarray        # [E, max_r] i32: logical -> phys slots
+    num_replicas: np.ndarray         # [E] i32
+    slots_per_shard: int             # P // ep
+
+    @property
+    def num_physical(self) -> int:
+        return len(self.phys_to_logical)
+
+
+def plan_placement(
+    load: Sequence[float],           # per-logical-expert observed load
+    num_redundant: int,
+    ep: int,
+) -> EplbPlan:
+    """Place E + num_redundant physical experts over ``ep`` shards."""
+    load = np.asarray(load, np.float64)
+    E = len(load)
+    P = E + num_redundant
+    if P % ep:
+        raise ValueError(
+            f"(experts {E} + redundant {num_redundant}) must divide over "
+            f"ep={ep} (reference constraint, decode.yaml:100-104)")
+    spp = P // ep
+
+    # 1. Replica counts: proportional to load, in [1, ep] each, sum = P.
+    # (More than ep replicas of one expert adds no parallelism — extras
+    # would share a shard with themselves.)
+    total = max(load.sum(), 1e-12)
+    ideal = load / total * P
+    counts = np.clip(np.floor(ideal).astype(int), 1, ep)
+    while counts.sum() > P:                      # too many: trim coldest >1
+        cand = np.where(counts > 1)[0]
+        counts[cand[np.argmin(load[cand])]] -= 1
+    rema = ideal - np.floor(ideal)
+    while counts.sum() < P:                      # largest remainder first
+        order = np.argsort(-rema)
+        progressed = False
+        for e in order:
+            if counts.sum() >= P:
+                break
+            if counts[e] >= ep:
+                continue
+            counts[e] += 1
+            rema[e] = -1                         # one bonus per round
+            progressed = True
+        if not progressed:
+            rema = ideal - np.floor(ideal)
+            if (counts >= ep).all():
+                raise ValueError("num_redundant too large: every expert "
+                                 "already has ep replicas")
+
+    # 2. Pack replicas onto shards: heaviest replica first into the least
+    # loaded shard with a free slot.
+    per_replica = load / counts                  # load a single replica carries
+    replicas: List[tuple] = []                   # (weight, logical)
+    for e in range(E):
+        replicas += [(per_replica[e], e)] * counts[e]
+    replicas.sort(key=lambda t: -t[0])
+
+    shard_load = np.zeros(ep)
+    shard_slots: List[List[int]] = [[] for _ in range(ep)]
+    for w, e in replicas:
+        open_shards = [s for s in range(ep) if len(shard_slots[s]) < spp]
+        s = min(open_shards, key=lambda s: (shard_load[s], s))
+        shard_slots[s].append(e)
+        shard_load[s] += w
+
+    phys_to_logical = np.asarray(
+        [e for s in range(ep) for e in shard_slots[s]], np.int32)
+    max_r = int(counts.max())
+    replica_table = np.zeros((E, max_r), np.int32)
+    num_replicas = np.zeros(E, np.int32)
+    for p, e in enumerate(phys_to_logical):
+        replica_table[e, num_replicas[e]] = p
+        num_replicas[e] += 1
+    for e in range(E):                           # pad with first replica
+        replica_table[e, num_replicas[e]:] = replica_table[e, 0]
+    return EplbPlan(E, phys_to_logical, replica_table, num_replicas, spp)
+
+
+def gather_physical(logical_weights, plan: EplbPlan):
+    """Build the physical expert-weight array from logical weights.
+
+    ``logical_weights``: array with leading expert dim [E, ...] (numpy or
+    jax). Returns [P, ...] gathered by the plan — the engine device_puts this
+    with the EP sharding to apply a rebalance."""
+    return logical_weights[plan.phys_to_logical]
+
+
+class LoadTracker:
+    """Sliding-window per-expert token counts (the ``window_size`` /
+    ``step_interval`` knobs of the reference's eplb-config)."""
+
+    def __init__(self, num_experts: int, window_size: int = 1000):
+        self.num_experts = num_experts
+        self.window_size = window_size
+        self._counts = np.zeros(num_experts, np.int64)
+        self._history: List[np.ndarray] = []
+
+    def record(self, expert_ids: np.ndarray) -> None:
+        """Record one step's routed expert ids (any shape of int array)."""
+        step = np.bincount(np.asarray(expert_ids).reshape(-1),
+                           minlength=self.num_experts).astype(np.int64)
+        self._history.append(step)
+        self._counts += step
+        while len(self._history) > self.window_size:
+            self._counts -= self._history.pop(0)
+
+    @property
+    def load(self) -> np.ndarray:
+        return self._counts.astype(np.float64)
+
+    def imbalance(self) -> float:
+        """max/mean per-expert load (1.0 = perfectly even)."""
+        mean = self.load.mean()
+        return float(self.load.max() / mean) if mean > 0 else 1.0

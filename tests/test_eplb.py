@@ -1,0 +1,79 @@
+"""EPLB: placement planning, physical dispatch, numeric equivalence.
+
+The invariant that matters: routing through an EPLB physical placement
+(replicated hot experts, arbitrary slot permutation) must produce exactly
+the same model output as the logical layout — replicas are copies.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_d_tpu.models.config import ModelConfig
+from llm_d_tpu.ops import moe as moe_ops
+from llm_d_tpu.parallel.eplb import (
+    LoadTracker, gather_physical, plan_placement)
+
+
+def test_plan_shapes_and_constraints():
+    load = [100, 1, 1, 1, 50, 1, 1, 1]
+    plan = plan_placement(load, num_redundant=8, ep=4)
+    assert plan.num_physical == 16
+    assert plan.slots_per_shard == 4
+    # Every logical expert has >= 1 replica; hottest has the most.
+    assert plan.num_replicas.min() >= 1
+    assert plan.num_replicas[0] == plan.num_replicas.max()
+    # replica_table entries point back at their logical expert.
+    for e in range(8):
+        for r in range(plan.num_replicas[e]):
+            assert plan.phys_to_logical[plan.replica_table[e, r]] == e
+
+
+def test_plan_rejects_bad_divisibility():
+    with pytest.raises(ValueError):
+        plan_placement([1.0] * 8, num_redundant=3, ep=4)
+
+
+def test_plan_balances_hot_expert():
+    # One expert carries ~all load; with redundancy its replicas must spread
+    # over distinct shards.
+    load = [1000, 1, 1, 1]
+    plan = plan_placement(load, num_redundant=4, ep=4)
+    hot_slots = plan.replica_table[0, :plan.num_replicas[0]]
+    shards = set(int(s) // plan.slots_per_shard for s in hot_slots)
+    assert len(shards) == len(hot_slots)       # each replica on its own shard
+
+
+def test_physical_dispatch_matches_logical():
+    E, k, T, H, I = 8, 2, 16, 32, 24
+    c = ModelConfig(num_experts=E, num_experts_per_tok=k, moe_renormalize=True)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(T, H), jnp.float32)
+    router = jnp.asarray(rng.randn(H, E), jnp.float32)
+    wg = jnp.asarray(rng.randn(E, H, I) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.randn(E, H, I) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.randn(E, I, H) * 0.1, jnp.float32)
+
+    weights, idx = moe_ops.route(jnp.dot(x, router), c)
+    logical = moe_ops.expert_ffn(x, weights, idx, wg, wu, wd)
+
+    plan = plan_placement(rng.rand(E), num_redundant=8, ep=4)
+    idx_p = moe_ops.to_physical_experts(
+        idx, jnp.asarray(plan.replica_table), jnp.asarray(plan.num_replicas))
+    physical = moe_ops.expert_ffn(
+        x, weights, idx_p,
+        jnp.asarray(gather_physical(np.asarray(wg), plan)),
+        jnp.asarray(gather_physical(np.asarray(wu), plan)),
+        jnp.asarray(gather_physical(np.asarray(wd), plan)))
+    np.testing.assert_allclose(np.asarray(physical), np.asarray(logical),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_load_tracker_window():
+    t = LoadTracker(4, window_size=2)
+    t.record(np.asarray([0, 0, 1]))
+    t.record(np.asarray([2]))
+    assert t.load.tolist() == [2, 1, 1, 0]
+    t.record(np.asarray([3, 3]))               # evicts first step
+    assert t.load.tolist() == [0, 0, 1, 2]
+    assert t.imbalance() == pytest.approx(2 / 0.75)
