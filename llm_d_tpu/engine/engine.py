@@ -56,6 +56,9 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     attn_backend: str = "auto"
     mesh: Optional[MeshConfig] = None        # None = single device
+    # Permit a mesh smaller than the host's device count (tests / dryruns on
+    # virtual device pools). Production default: fail fast on idle chips.
+    allow_device_subset: bool = False
     seed: int = 0
     min_token_bucket: int = 16
     min_seq_bucket: int = 8
@@ -80,8 +83,10 @@ class EngineCore:
         self.model_config = config.resolve_model()
         c = self.model_config
 
-        self.mesh = make_mesh(config.mesh) if config.mesh else make_mesh(
-            MeshConfig(), [jax.devices()[0]])
+        self.mesh = (make_mesh(config.mesh,
+                               allow_subset=config.allow_device_subset)
+                     if config.mesh
+                     else make_mesh(MeshConfig(), [jax.devices()[0]]))
         self.kv_manager = KVCacheManager(
             config.num_blocks, config.block_size,
             enable_prefix_caching=config.enable_prefix_caching)
@@ -118,6 +123,10 @@ class EngineCore:
         # PD producer: finished prefills whose blocks stay pinned until the
         # decode engine pulls them (reference contract: README.tpu.md:182-189).
         self.pinned_transfers: Dict[str, Request] = {}
+        # Stalled-request abort must wait for pinned PD blocks (released
+        # asynchronously when the decode engine finishes its pull).
+        self.scheduler.external_pinned_blocks = lambda: sum(
+            len(r.block_ids) for r in self.pinned_transfers.values())
         # Optional KV connector (set by the server / PD wiring).
         self.kv_connector = None
         self.eos_token_id: Optional[int] = None

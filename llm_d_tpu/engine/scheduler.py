@@ -55,6 +55,10 @@ class Scheduler:
         self.waiting: collections.deque[Request] = collections.deque()
         self.running: List[Request] = []
         self.num_preemptions = 0
+        # Blocks held outside the scheduler (e.g. PD producer pins awaiting a
+        # remote pull). While any exist, a stalled sole-running request waits
+        # for their asynchronous release instead of being aborted.
+        self.external_pinned_blocks = lambda: 0
 
     # ---------- queue ops ----------
 
@@ -156,9 +160,12 @@ class Scheduler:
                     break
             if n <= 0:
                 # Nothing schedulable and nothing preemptable: if no other
-                # request holds reclaimable blocks this will never resolve.
+                # request holds reclaimable blocks this will never resolve —
+                # unless blocks are pinned outside the scheduler (PD transfer
+                # in flight), whose async release will unblock us.
                 if not scheduled and len(self.running) == 1 \
-                        and not self.kv.can_allocate(1):
+                        and not self.kv.can_allocate(1) \
+                        and self.external_pinned_blocks() == 0:
                     self.running.remove(req)
                     self.kv.free(req)
                     req.state = RequestState.FINISHED_ABORTED

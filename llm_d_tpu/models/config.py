@@ -43,6 +43,15 @@ class ModelConfig:
     n_group: int = 0                        # DeepSeek group-limited routing (0=off)
     topk_group: int = 0
     routed_scaling_factor: float = 1.0
+    # "softmax" (Mixtral/Qwen-MoE) or "sigmoid" (DeepSeek-V3/R1: sigmoid
+    # scores + e_score_correction_bias used for selection only).
+    scoring_func: str = "softmax"
+
+    def __post_init__(self):
+        if self.scoring_func not in ("softmax", "sigmoid"):
+            raise ValueError(
+                f"scoring_func must be 'softmax' or 'sigmoid', "
+                f"got {self.scoring_func!r}")
 
     @property
     def head_dim_(self) -> int:
@@ -103,7 +112,7 @@ PRESETS = {
         head_dim=128, rope_theta=10000.0, max_model_len=32000,
         num_experts=256, num_experts_per_tok=8, moe_intermediate_size=2048,
         num_shared_experts=1, first_dense_layers=3, n_group=8, topk_group=4,
-        routed_scaling_factor=2.5),
+        routed_scaling_factor=2.5, scoring_func="sigmoid"),
 }
 
 

@@ -167,17 +167,29 @@ def load_moe_from_state_dict(
     m["router"] = jnp.asarray(np.stack(
         [arr(f"{prefix}layers.{li}.mlp.gate.weight").T for li in moe_ids]),
         jnp.float32)
+    if f"{prefix}layers.{moe_ids[0]}.mlp.gate.e_score_correction_bias" in weights:
+        # DeepSeek-V3 sigmoid-selection bias (applied to routing choice only).
+        m["e_bias"] = jnp.asarray(np.stack(
+            [arr(f"{prefix}layers.{li}.mlp.gate.e_score_correction_bias")
+             for li in moe_ids]), jnp.float32)
+    elif c.scoring_func == "sigmoid":
+        m["e_bias"] = jnp.zeros((len(moe_ids), c.num_experts), jnp.float32)
     for ours, hf in (("w_gate", "gate_proj"), ("w_up", "up_proj"),
                      ("w_down", "down_proj")):
         m[ours] = jnp.asarray(np.stack([
             np.stack([arr(f"{prefix}layers.{li}.mlp.experts.{e}.{hf}.weight").T
                       for e in range(c.num_experts)])
             for li in moe_ids]), dt)
+    # Shared experts load only when the config declares them: DeepSeek's
+    # ungated add.  (Qwen2-MoE's *gated* shared expert is a different op and
+    # is deliberately not claimed — loading its weights into the ungated path
+    # would silently diverge from HF.)
     shared_prefix = None
-    for cand in ("mlp.shared_experts", "mlp.shared_expert"):
-        if f"{prefix}layers.{moe_ids[0]}.{cand}.gate_proj.weight" in weights:
-            shared_prefix = cand
-            break
+    if c.num_shared_experts > 0:
+        for cand in ("mlp.shared_experts", "mlp.shared_expert"):
+            if f"{prefix}layers.{moe_ids[0]}.{cand}.gate_proj.weight" in weights:
+                shared_prefix = cand
+                break
     if shared_prefix is not None:
         for ours, hf in (("shared_gate", "gate_proj"),
                          ("shared_up", "up_proj"),
@@ -202,13 +214,26 @@ def load_from_safetensors_dir(config: ModelConfig, path: str) -> Dict[str, Any]:
         with safe_open(os.path.join(path, fname), framework="np") as f:
             for key in f.keys():
                 weights[key] = f.get_tensor(key)
+    if config.is_moe:
+        return load_moe_from_state_dict(config, weights)
     return load_dense_from_state_dict(config, weights)
 
 
 def config_from_hf_dir(path: str, name: str = "hf") -> ModelConfig:
-    """Derive a ModelConfig from an HF ``config.json``."""
+    """Derive a ModelConfig from an HF ``config.json`` (dense or MoE).
+
+    MoE field names follow DeepSeek-V2/V3 (``n_routed_experts``,
+    ``num_experts_per_tok``, ``moe_intermediate_size``, ``n_shared_experts``,
+    ``first_k_dense_replace``, ``n_group``/``topk_group``,
+    ``routed_scaling_factor``, ``scoring_func``); the routed-expert count
+    also falls back to Mixtral's ``num_local_experts``.  Qwen2-MoE's *gated*
+    shared expert is not supported (its weights are skipped, not mis-added).
+    """
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
+    num_experts = int(hf.get("n_routed_experts")
+                      or hf.get("num_local_experts")
+                      or hf.get("num_experts") or 0)
     return ModelConfig(
         name=name,
         vocab_size=hf["vocab_size"],
@@ -225,4 +250,17 @@ def config_from_hf_dir(path: str, name: str = "hf") -> ModelConfig:
         or hf.get("model_type") == "qwen2",
         qk_norm=hf.get("model_type") == "qwen3",
         max_model_len=min(hf.get("max_position_embeddings", 32000), 32000),
+        num_experts=num_experts,
+        num_experts_per_tok=int(hf.get("num_experts_per_tok", 0)
+                                if num_experts else 0),
+        moe_intermediate_size=int(hf.get("moe_intermediate_size", 0)
+                                  or (hf["intermediate_size"]
+                                      if num_experts else 0)),
+        num_shared_experts=int(hf.get("n_shared_experts") or 0),
+        first_dense_layers=int(hf.get("first_k_dense_replace") or 0),
+        moe_renormalize=bool(hf.get("norm_topk_prob", True)),
+        n_group=int(hf.get("n_group") or 0),
+        topk_group=int(hf.get("topk_group") or 0),
+        routed_scaling_factor=float(hf.get("routed_scaling_factor", 1.0)),
+        scoring_func=hf.get("scoring_func", "softmax"),
     )

@@ -81,6 +81,8 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         "w_up": w((Lm, E, c.hidden_size, Im), next(k)),
         "w_down": w((Lm, E, Im, c.hidden_size), next(k)),
     })
+    if c.scoring_func == "sigmoid":
+        moe["e_bias"] = jnp.zeros((Lm, E), jnp.float32)
     if c.num_shared_experts > 0:
         moe.update({
             "shared_gate": w((Lm, c.hidden_size, Ish), next(k)),
@@ -132,7 +134,8 @@ def forward(
         h = h + a
         hn = L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps)
         weights, idx = moe_ops.route(
-            jnp.dot(hn.astype(jnp.float32), lp["router"]), c)
+            jnp.dot(hn.astype(jnp.float32), lp["router"]), c,
+            e_bias=lp.get("e_bias"))
         m = moe_ops.expert_ffn(
             hn, weights, idx, lp["w_gate"], lp["w_up"], lp["w_down"],
             mesh=mesh)

@@ -35,8 +35,14 @@ from llm_d_tpu.parallel.mesh import AXIS_EP
 def route(
     router_logits: jax.Array,      # [T, E] f32
     config: ModelConfig,
+    e_bias: Optional[jax.Array] = None,   # [E] sigmoid-selection bias
 ) -> Tuple[jax.Array, jax.Array]:  # (weights [T, k] f32, idx [T, k] i32)
     """Top-k expert selection with optional DeepSeek group-limited routing.
+
+    Scoring follows ``config.scoring_func``: ``softmax`` (Mixtral / Qwen-MoE)
+    or ``sigmoid`` (DeepSeek-V3/R1), where ``e_score_correction_bias`` is
+    added for group/expert *selection only* and combine weights come from the
+    un-biased sigmoid scores.
 
     With ``n_group > 0`` the expert set is partitioned into groups; only the
     ``topk_group`` groups with the highest (sum of top-2 member scores) stay
@@ -47,20 +53,28 @@ def route(
     c = config
     T, E = router_logits.shape
     k = c.num_experts_per_tok
-    scores = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    logits = router_logits.astype(jnp.float32)
+    if c.scoring_func == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + (e_bias.astype(jnp.float32)[None, :]
+                           if e_bias is not None else 0.0)
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+        choice = scores
 
     if c.n_group > 0:
         g = c.n_group
-        gs = scores.reshape(T, g, E // g)
+        gs = choice.reshape(T, g, E // g)
         # Group score: sum of each group's top-2 expert scores (V3 scheme).
         top2 = jax.lax.top_k(gs, min(2, E // g))[0].sum(-1)     # [T, g]
         _, keep = jax.lax.top_k(top2, c.topk_group)             # [T, topk_group]
         mask = jnp.zeros((T, g), bool).at[
             jnp.arange(T)[:, None], keep].set(True)
-        scores = jnp.where(
-            jnp.repeat(mask, E // g, axis=1), scores, 0.0)
+        choice = jnp.where(
+            jnp.repeat(mask, E // g, axis=1), choice, -jnp.inf)
 
-    weights, idx = jax.lax.top_k(scores, k)                     # [T, k]
+    _, idx = jax.lax.top_k(choice, k)                           # [T, k]
+    weights = jnp.take_along_axis(scores, idx, axis=1)
     if c.moe_renormalize:
         weights = weights / jnp.maximum(
             weights.sum(-1, keepdims=True), 1e-20)
@@ -189,11 +203,13 @@ def moe_ffn_reference(
     router_w: jax.Array,   # [H, E]
     w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array,
     config: ModelConfig,
+    e_bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Dense-dispatch oracle: every expert computed for every token, combined
     with the routing weights.  O(T*E) FLOPs — tests only."""
     weights, idx = route(
-        jnp.dot(x.astype(jnp.float32), router_w.astype(jnp.float32)), config)
+        jnp.dot(x.astype(jnp.float32), router_w.astype(jnp.float32)), config,
+        e_bias=e_bias)
     T, k = idx.shape
     E = w_gate.shape[0]
     comb = jnp.zeros((T, E), jnp.float32).at[

@@ -57,16 +57,22 @@ class MeshConfig:
 def make_mesh(
     config: Optional[MeshConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    allow_subset: bool = False,
 ) -> Mesh:
     """Build a 3D mesh (dp, sp, tp) over ``devices``.
 
     Default config: all local devices on the ``tp`` axis (single-replica
     tensor parallelism, the most common single-slice serving layout).
+
+    A config smaller than the device list is an error unless
+    ``allow_subset=True`` (dryruns/tests deliberately using fewer virtual
+    devices): silently idling chips on a production host is a
+    misconfiguration that should fail fast.
     """
     devices = list(devices if devices is not None else jax.devices())
     if config is None:
         config = MeshConfig(tp=len(devices))
-    if config.num_devices < len(devices):
+    if allow_subset and config.num_devices < len(devices):
         devices = devices[:config.num_devices]
     if config.num_devices != len(devices):
         raise ValueError(

@@ -276,6 +276,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--max-num-seqs", type=int, default=128)
     p.add_argument("--max-num-batched-tokens", type=int, default=2048)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument(
+        "--allow-device-subset", action="store_true",
+        help="permit a mesh smaller than the host's device count "
+             "(deliberately idle chips); default is to fail fast")
     args = p.parse_args(argv)
 
     from llm_d_tpu.parallel.mesh import MeshConfig
@@ -283,8 +288,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         model=args.model, block_size=args.block_size,
         num_blocks=args.num_blocks, max_num_seqs=args.max_num_seqs,
         max_num_batched_tokens=args.max_num_batched_tokens,
-        mesh=MeshConfig(tp=args.tensor_parallel_size)
-        if args.tensor_parallel_size > 1 else None)
+        mesh=MeshConfig(dp=args.data_parallel_size,
+                        tp=args.tensor_parallel_size)
+        if args.tensor_parallel_size * args.data_parallel_size > 1 else None,
+        allow_device_subset=args.allow_device_subset)
     server = build_server(cfg, args.tokenizer)
     logging.basicConfig(level=logging.INFO)
     web.run_app(server.build_app(), host=args.host, port=args.port)

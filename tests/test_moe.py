@@ -55,6 +55,105 @@ def test_route_scaling_factor():
     np.testing.assert_allclose(float(w.sum()), 2.5, rtol=1e-6)
 
 
+def test_route_sigmoid_bias_selects_but_does_not_weight():
+    """DeepSeek-V3 scheme: e_score_correction_bias steers *selection* only;
+    combine weights are the un-biased sigmoid scores, renormalized."""
+    c = ModelConfig(num_experts=4, num_experts_per_tok=2,
+                    scoring_func="sigmoid", moe_renormalize=True)
+    logits = jnp.asarray([[2.0, 1.0, 0.5, 0.0]])
+    # Without bias, experts {0, 1} win.
+    _, idx0 = moe_ops.route(logits, c)
+    assert sorted(np.asarray(idx0[0]).tolist()) == [0, 1]
+    # A large bias on expert 3 flips selection to {0, 3}...
+    bias = jnp.asarray([0.0, 0.0, 0.0, 10.0])
+    w, idx = moe_ops.route(logits, c, e_bias=bias)
+    assert sorted(np.asarray(idx[0]).tolist()) == [0, 3]
+    # ...but the weights come from the raw sigmoid scores (no bias):
+    s = jax.nn.sigmoid(logits[0])
+    expected = np.asarray([s[0], s[3]]) / float(s[0] + s[3])
+    got = {int(i): float(v) for i, v in zip(np.asarray(idx[0]),
+                                            np.asarray(w[0]))}
+    np.testing.assert_allclose(got[0], expected[0], rtol=1e-6)
+    np.testing.assert_allclose(got[3], expected[1], rtol=1e-6)
+
+
+def test_config_from_hf_dir_maps_moe_fields(tmp_path):
+    import json
+    from llm_d_tpu.models.loader import config_from_hf_dir
+    hf = dict(vocab_size=512, hidden_size=64, intermediate_size=128,
+              num_hidden_layers=4, num_attention_heads=4,
+              num_key_value_heads=2, n_routed_experts=16,
+              num_experts_per_tok=4, moe_intermediate_size=32,
+              n_shared_experts=1, first_k_dense_replace=1, n_group=4,
+              topk_group=2, routed_scaling_factor=2.5,
+              scoring_func="sigmoid", norm_topk_prob=True)
+    (tmp_path / "config.json").write_text(json.dumps(hf))
+    c = config_from_hf_dir(str(tmp_path))
+    assert c.is_moe and c.num_experts == 16 and c.num_experts_per_tok == 4
+    assert c.moe_intermediate_size == 32 and c.num_shared_experts == 1
+    assert c.first_dense_layers == 1 and c.n_group == 4 and c.topk_group == 2
+    assert c.routed_scaling_factor == 2.5 and c.scoring_func == "sigmoid"
+
+
+def test_safetensors_dir_moe_dispatch(tmp_path):
+    """load_from_safetensors_dir routes MoE configs to the MoE loader
+    (advisor r2: previously always used the dense mapping -> KeyError)."""
+    import torch
+    from safetensors.torch import save_file
+    from llm_d_tpu.models.loader import load_from_safetensors_dir
+
+    c = CFG
+    dh = c.head_dim_
+    sd = {
+        "model.embed_tokens.weight": torch.zeros(c.vocab_size, c.hidden_size),
+        "model.norm.weight": torch.ones(c.hidden_size),
+        "lm_head.weight": torch.zeros(c.vocab_size, c.hidden_size),
+    }
+    for li in range(c.num_layers):
+        p = f"model.layers.{li}."
+        sd[p + "input_layernorm.weight"] = torch.ones(c.hidden_size)
+        sd[p + "post_attention_layernorm.weight"] = torch.ones(c.hidden_size)
+        sd[p + "self_attn.q_proj.weight"] = torch.zeros(
+            c.num_heads * dh, c.hidden_size)
+        sd[p + "self_attn.k_proj.weight"] = torch.zeros(
+            c.num_kv_heads * dh, c.hidden_size)
+        sd[p + "self_attn.v_proj.weight"] = torch.zeros(
+            c.num_kv_heads * dh, c.hidden_size)
+        sd[p + "self_attn.o_proj.weight"] = torch.zeros(
+            c.hidden_size, c.num_heads * dh)
+        if li < c.first_dense_layers:
+            sd[p + "mlp.gate_proj.weight"] = torch.zeros(
+                c.intermediate_size, c.hidden_size)
+            sd[p + "mlp.up_proj.weight"] = torch.zeros(
+                c.intermediate_size, c.hidden_size)
+            sd[p + "mlp.down_proj.weight"] = torch.zeros(
+                c.hidden_size, c.intermediate_size)
+        else:
+            sd[p + "mlp.gate.weight"] = torch.zeros(
+                c.num_experts, c.hidden_size)
+            for e in range(c.num_experts):
+                ep = f"{p}mlp.experts.{e}."
+                sd[ep + "gate_proj.weight"] = torch.zeros(
+                    c.moe_intermediate_size, c.hidden_size)
+                sd[ep + "up_proj.weight"] = torch.zeros(
+                    c.moe_intermediate_size, c.hidden_size)
+                sd[ep + "down_proj.weight"] = torch.zeros(
+                    c.hidden_size, c.moe_intermediate_size)
+            sp = p + "mlp.shared_experts."
+            sd[sp + "gate_proj.weight"] = torch.zeros(
+                c.moe_intermediate_size, c.hidden_size)
+            sd[sp + "up_proj.weight"] = torch.zeros(
+                c.moe_intermediate_size, c.hidden_size)
+            sd[sp + "down_proj.weight"] = torch.zeros(
+                c.hidden_size, c.moe_intermediate_size)
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    params = load_from_safetensors_dir(c, str(tmp_path))
+    assert "moe_layers" in params and "dense_layers" in params
+    Lm = c.num_layers - c.first_dense_layers
+    assert params["moe_layers"]["w_gate"].shape == (
+        Lm, c.num_experts, c.hidden_size, c.moe_intermediate_size)
+
+
 # ---------- grouped GEMM vs dense dispatch ----------
 
 @pytest.mark.parametrize("T,E,k", [(16, 8, 2), (7, 4, 3)])
@@ -149,7 +248,7 @@ def oracle_moe_generate(params, prompt, n_out):
 def moe_engine_cfg(mesh=None, **kw):
     base = dict(model="tiny-moe", block_size=4, num_blocks=64, max_num_seqs=8,
                 max_num_batched_tokens=64, min_token_bucket=16,
-                min_seq_bucket=4, mesh=mesh)
+                min_seq_bucket=4, mesh=mesh, allow_device_subset=True)
     base.update(kw)
     return EngineConfig(**base)
 

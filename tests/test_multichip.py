@@ -35,7 +35,7 @@ def greedy_req(rid, prompt, n=6):
 def engine_cfg(mesh=None, **kw):
     base = dict(model="tiny", block_size=4, num_blocks=64, max_num_seqs=8,
                 max_num_batched_tokens=64, min_token_bucket=16,
-                min_seq_bucket=4, mesh=mesh)
+                min_seq_bucket=4, mesh=mesh, allow_device_subset=True)
     base.update(kw)
     return EngineConfig(**base)
 
