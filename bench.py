@@ -1,8 +1,14 @@
 """Single-chip serving benchmark.
 
-Measures steady-state decode throughput of the flagship dense model through
-the REAL engine path (continuous batching, paged KV, on-device sampling) on
-whatever accelerator JAX exposes (one TPU chip under the driver).
+Measures steady-state prefill and decode throughput of the flagship dense
+model through the REAL engine path (continuous batching, paged KV, on-device
+sampling) on whatever accelerator JAX exposes (one TPU chip under the
+driver).
+
+Methodology: a full warmup pass (identical shapes, disjoint token ids)
+compiles every bucket the timed pass will hit, so the numbers are
+steady-state throughput, not XLA compile time.  Extras report MFU and the
+decode HBM-roofline fraction so regressions are attributable.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": r}
@@ -27,6 +33,50 @@ from llm_d_tpu.ops.sampling import SamplingParams
 
 BASELINE_TOK_S_PER_CHIP = 2200.0
 
+# (bf16 peak FLOP/s, HBM bytes/s) per TPU generation; conservative defaults.
+_CHIP_SPECS = {
+    "v3": (123e12, 900e9),
+    "v4": (275e12, 1228e9),
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v5": (459e12, 2765e9),
+    "v6 lite": (918e12, 1638e9),
+    "v6e": (918e12, 1638e9),
+}
+
+
+def _chip_spec(device) -> tuple:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, spec in _CHIP_SPECS.items():
+        if key in kind:
+            return spec
+    return (197e12, 819e9)
+
+
+def _param_bytes_and_count(params) -> tuple:
+    leaves = jax.tree.leaves(params)
+    return (sum(x.size * x.dtype.itemsize for x in leaves),
+            sum(x.size for x in leaves))
+
+
+def _run_workload(engine, reqs):
+    """Returns (prefill_seconds, decode_seconds, decode_tokens)."""
+    for r in reqs:
+        engine.add_request(r)
+    t0 = time.perf_counter()
+    while any(r.num_computed_tokens < r.num_prompt_tokens for r in reqs):
+        engine.step()
+    t_prefill = time.perf_counter() - t0
+
+    tokens_before = sum(len(r.output_token_ids) for r in reqs)
+    t1 = time.perf_counter()
+    while engine.has_work():
+        engine.step()
+    t_decode = time.perf_counter() - t1
+    tokens_after = sum(len(r.output_token_ids) for r in reqs)
+    return t_prefill, t_decode, tokens_after - tokens_before
+
 
 def main() -> None:
     n_seqs = 64
@@ -40,39 +90,61 @@ def main() -> None:
         max_num_seqs=n_seqs,
         max_num_batched_tokens=8192,
         num_scheduler_steps=32,
+        # Disjoint warmup/timed prompts must not share KV anyway; disabling
+        # removes any chance the warmup pass warms more than the compiles.
+        enable_prefix_caching=False,
     )
     engine = EngineCore(cfg)
 
-    reqs = [
-        Request(
-            request_id=f"bench-{i}",
-            prompt_token_ids=[(7 * i + j) % 32000 + 1 for j in range(prompt_len)],
-            sampling=SamplingParams(temperature=0.0, max_tokens=decode_steps + 1,
-                                    ignore_eos=True),
-        )
-        for i in range(n_seqs)
-    ]
-    for r in reqs:
-        engine.add_request(r)
+    def make_reqs(tag: str, offset: int):
+        return [
+            Request(
+                request_id=f"{tag}-{i}",
+                prompt_token_ids=[(7 * i + 13 * j + offset) % 32000 + 1
+                                  for j in range(prompt_len)],
+                sampling=SamplingParams(temperature=0.0,
+                                        max_tokens=decode_steps + 1,
+                                        ignore_eos=True),
+            )
+            for i in range(n_seqs)
+        ]
 
-    # Prefill (also warms up compile for the prefill bucket).
-    t0 = time.perf_counter()
-    while any(r.num_computed_tokens < r.num_prompt_tokens for r in reqs):
-        engine.step()
-    t_prefill = time.perf_counter() - t0
+    # Warmup: identical shapes -> compiles every (T, S) bucket and the fused
+    # multistep program the timed pass uses.
+    _run_workload(engine, make_reqs("warm", 50000))
 
-    # One decode step to compile the decode bucket before timing.
-    engine.step()
+    t_prefill, t_decode, decode_tokens = _run_workload(
+        engine, make_reqs("bench", 0))
 
-    tokens_before = sum(len(r.output_token_ids) for r in reqs)
-    t1 = time.perf_counter()
-    while engine.has_work():
-        engine.step()
-    t_decode = time.perf_counter() - t1
-    tokens_after = sum(len(r.output_token_ids) for r in reqs)
+    prompt_tokens = n_seqs * prompt_len
+    prefill_tok_s = prompt_tokens / t_prefill
+    decode_tok_s = decode_tokens / t_decode
 
-    decode_tok_s = (tokens_after - tokens_before) / t_decode
-    ttft = t_prefill / 1.0
+    # --- MFU / roofline attribution ---
+    peak_flops, hbm_bw = _chip_spec(jax.devices()[0])
+    param_bytes, param_count = _param_bytes_and_count(engine.params)
+    c = engine.model_config
+    # Embedding rows are gathered (no FLOPs); the lm_head matmul runs only
+    # for sampling rows — all prompt tokens in prefill share S head rows,
+    # while every decode token is a sampling row.
+    embed_params = c.vocab_size * c.hidden_size
+    head_params = 0 if c.tie_word_embeddings else embed_params
+    body_flops_per_token = 2 * (param_count - embed_params - head_params)
+    head_flops = 2 * embed_params   # lm_head matmul per sampled row
+    prefill_flops = body_flops_per_token * prompt_tokens \
+        + head_flops * n_seqs
+    prefill_mfu = prefill_flops / t_prefill / peak_flops
+    decode_mfu = decode_tok_s * (body_flops_per_token + head_flops) \
+        / peak_flops
+    # Decode is HBM-bound: each fused step reads the weights (embed table
+    # excluded: only S rows are gathered) plus each sequence's KV context.
+    avg_ctx = prompt_len + decode_steps // 2
+    kv_bytes_per_seq = 2 * c.num_layers * avg_ctx * c.num_kv_heads \
+        * c.head_dim_ * 2
+    embed_bytes = embed_params * 2
+    step_bytes = param_bytes - embed_bytes + n_seqs * kv_bytes_per_seq
+    roofline_tok_s = hbm_bw / step_bytes * n_seqs
+    decode_roofline_pct = decode_tok_s / roofline_tok_s
 
     result = {
         "metric": "decode_output_tok_s_per_chip_llama1b_bs64",
@@ -81,7 +153,12 @@ def main() -> None:
         "vs_baseline": round(decode_tok_s / BASELINE_TOK_S_PER_CHIP, 3),
         "extras": {
             "backend": jax.default_backend(),
+            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+            "prefill_tok_s": round(prefill_tok_s, 1),
             "prefill_s_64x128": round(t_prefill, 3),
+            "prefill_mfu_pct": round(100 * prefill_mfu, 2),
+            "decode_mfu_pct": round(100 * decode_mfu, 2),
+            "decode_hbm_roofline_pct": round(100 * decode_roofline_pct, 1),
             "decode_steps": decode_steps,
             "batch_size": n_seqs,
         },

@@ -73,8 +73,12 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
 def attention_block(
     lp: Params, config: ModelConfig, x: jax.Array, batch: Dict[str, jax.Array],
     k_cache: jax.Array, v_cache: jax.Array, block_size: int, attn_backend: str,
+    layer: jax.Array = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Shared by dense and MoE models. Returns (attn_out, k_cache', v_cache')."""
+    """Shared by dense and MoE models. Returns (attn_out, k_cache', v_cache').
+
+    With ``layer`` the caches are the full stacked [L, slots, F] buffers
+    updated in place (see ops.attention.attention_with_kv_update)."""
     c = config
     dh = c.head_dim_
     T = x.shape[0]
@@ -92,7 +96,7 @@ def attention_block(
 
     attn, k_cache, v_cache = attention_with_kv_update(
         q, kx, vx, k_cache, v_cache, batch,
-        block_size=block_size, backend=attn_backend)
+        block_size=block_size, backend=attn_backend, layer=layer)
     out = L.linear(attn.reshape(T, c.num_heads * dh), lp["o_proj"])
     return out, k_cache, v_cache
 
@@ -113,21 +117,25 @@ def forward(
     c = config
     x = params["embed"][batch["token_ids"]]          # [T, D]
 
-    def layer_body(carry, xs):
-        h = carry
-        lp, k_l, v_l = xs
-        a, k_l, v_l = attention_block(
+    # The FULL stacked KV cache rides the scan carry and each layer updates
+    # its plane in place (Pallas aliasing / scatter-at-layer): slicing the
+    # cache into per-layer xs/ys moved 2x the whole cache through HBM every
+    # step (~10 ms at 1B scale) — the dominant decode cost before this.
+    def layer_body(carry, lp):
+        h, kv_k, kv_v, li = carry
+        a, kv_k, kv_v = attention_block(
             lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
-            batch, k_l, v_l, block_size, attn_backend)
+            batch, kv_k, kv_v, block_size, attn_backend, layer=li)
         h = h + a
         m = L.swiglu_mlp(
             L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps),
             lp["gate_proj"], lp["up_proj"], lp["down_proj"])
         h = h + m
-        return h, (k_l, v_l)
+        return (h, kv_k, kv_v, li + 1), None
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer_body, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    (x, k_new, v_new, _), _ = jax.lax.scan(
+        layer_body, (x, kv_cache["k"], kv_cache["v"], jnp.int32(0)),
+        params["layers"])
 
     x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
     # Only sampling positions need logits: gather last-token rows per sequence.

@@ -113,24 +113,24 @@ def forward(
     Ld = c.first_dense_layers
     x = params["embed"][batch["token_ids"]]
 
-    def dense_body(carry, xs):
-        h = carry
-        lp, k_l, v_l = xs
-        a, k_l, v_l = attention_block(
+    # Full stacked KV cache rides both scans' carries; each layer updates its
+    # plane in place (see models.llama.forward) — no split/concat copies.
+    def dense_body(carry, lp):
+        h, kv_k, kv_v, li = carry
+        a, kv_k, kv_v = attention_block(
             lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
-            batch, k_l, v_l, block_size, attn_backend)
+            batch, kv_k, kv_v, block_size, attn_backend, layer=li)
         h = h + a
         m = L.swiglu_mlp(
             L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps),
             lp["gate_proj"], lp["up_proj"], lp["down_proj"])
-        return h + m, (k_l, v_l)
+        return (h + m, kv_k, kv_v, li + 1), None
 
-    def moe_body(carry, xs):
-        h = carry
-        lp, k_l, v_l = xs
-        a, k_l, v_l = attention_block(
+    def moe_body(carry, lp):
+        h, kv_k, kv_v, li = carry
+        a, kv_k, kv_v = attention_block(
             lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
-            batch, k_l, v_l, block_size, attn_backend)
+            batch, kv_k, kv_v, block_size, attn_backend, layer=li)
         h = h + a
         hn = L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps)
         weights, idx = moe_ops.route(
@@ -142,21 +142,17 @@ def forward(
         if "shared_gate" in lp:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])
-        return h + m, (k_l, v_l)
+        return (h + m, kv_k, kv_v, li + 1), None
 
-    k_d, k_m = kv_cache["k"][:Ld], kv_cache["k"][Ld:]
-    v_d, v_m = kv_cache["v"][:Ld], kv_cache["v"][Ld:]
-    x, (k_d, v_d) = jax.lax.scan(
-        dense_body, x, (params["dense_layers"], k_d, v_d))
-    x, (k_m, v_m) = jax.lax.scan(
-        moe_body, x, (params["moe_layers"], k_m, v_m))
+    (x, k_new, v_new, li), _ = jax.lax.scan(
+        dense_body, (x, kv_cache["k"], kv_cache["v"], jnp.int32(0)),
+        params["dense_layers"])
+    (x, k_new, v_new, _), _ = jax.lax.scan(
+        moe_body, (x, k_new, v_new, li), params["moe_layers"])
 
     x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
     sample_hidden = x[batch["sample_idx"]]
-    return sample_hidden, {
-        "k": jnp.concatenate([k_d, k_m]),
-        "v": jnp.concatenate([v_d, v_m]),
-    }
+    return sample_hidden, {"k": k_new, "v": v_new}
 
 
 def sharding_rules(config: ModelConfig):
