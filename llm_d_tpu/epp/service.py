@@ -1,0 +1,209 @@
+"""llmd-gateway: the inference-scheduler service (EPP) with a data plane.
+
+The reference EPP is an Envoy ext_proc sidekick: Envoy streams each request
+to it, the plugin pipeline picks an endpoint, and Envoy routes on the
+returned ``x-gateway-destination-endpoint`` header (reference:
+standalone-inference-scheduling/values.yaml:118-181).  This service packages
+the same pipeline behind a self-contained HTTP gateway — it schedules AND
+forwards, so no Envoy is required for the first well-lit path — while the
+scheduling core (``EppScheduler``) stays transport-agnostic for an ext_proc
+front end.
+
+Surfaces:
+  POST /v1/completions, /v1/chat/completions  -> schedule + proxy
+  GET  /v1/models                             -> proxy to any ready endpoint
+  GET  /health                                -> gateway liveness
+  GET  /metrics                               -> inference_extension_* metrics
+  ZMQ SUB :5557                               -> KV events feeding the
+                                                 precise prefix index
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from llm_d_tpu.epp.config import DEFAULT_CONFIG_YAML, parse_config
+from llm_d_tpu.epp.datastore import Datastore, EndpointState
+from llm_d_tpu.epp.indexer import PrefixIndex, ZmqEventSubscriber
+from llm_d_tpu.epp.plugins import RequestCtx
+from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
+from llm_d_tpu.utils.metrics import EppMetrics
+
+logger = logging.getLogger(__name__)
+
+
+def parse_endpoint_arg(arg: str) -> EndpointState:
+    """"host:port" or "host:port=prefill|decode|both"."""
+    role = "both"
+    if "=" in arg:
+        arg, role = arg.rsplit("=", 1)
+    return EndpointState(address=arg, role=role)
+
+
+class Gateway:
+    def __init__(self, scheduler: EppScheduler, datastore: Datastore,
+                 subscriber: Optional[ZmqEventSubscriber] = None) -> None:
+        self.scheduler = scheduler
+        self.datastore = datastore
+        self.subscriber = subscriber
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_post("/v1/completions", self.proxy_inference)
+        app.router.add_post("/v1/chat/completions", self.proxy_inference)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._session = aiohttp.ClientSession()
+        await self.datastore.start()
+        if self.subscriber is not None:
+            self.subscriber.start()
+
+    async def _on_cleanup(self, app) -> None:
+        await self.datastore.stop()
+        if self.subscriber is not None:
+            self.subscriber.stop()
+        if self._session:
+            await self._session.close()
+
+    # ---------- endpoints ----------
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.scheduler.metrics.render(),
+                            content_type="text/plain")
+
+    async def models(self, request: web.Request) -> web.Response:
+        for e in self.datastore.candidates():
+            if not e.ready:
+                continue
+            try:
+                async with self._session.get(
+                        f"{e.url}/v1/models",
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    return web.json_response(await r.json(), status=r.status)
+            except Exception:
+                continue
+        return web.json_response({"error": "no ready endpoints"}, status=503)
+
+    async def proxy_inference(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid json"}, status=400)
+
+        ctx = self._make_ctx(body, request)
+        result = self.scheduler.schedule(ctx)
+        primary = result.primary
+        if primary is None:
+            return web.json_response(
+                {"error": "no ready endpoints"}, status=503)
+
+        # PD: hand the sidecar its prefill hint via the request headers.
+        fwd_headers = {k: v for k, v in result.headers.items()
+                       if k != DESTINATION_HEADER}
+        url = f"{primary.url}{request.path}"
+        try:
+            upstream = await self._session.post(
+                url, json=body, headers=fwd_headers,
+                timeout=aiohttp.ClientTimeout(total=600))
+        except Exception as exc:
+            return web.json_response(
+                {"error": f"upstream {primary.address} failed: {exc}"},
+                status=502)
+
+        resp = web.StreamResponse(status=upstream.status)
+        for k in ("Content-Type",):
+            if k in upstream.headers:
+                resp.headers[k] = upstream.headers[k]
+        resp.headers[DESTINATION_HEADER] = primary.address
+        await resp.prepare(request)
+        async for chunk in upstream.content.iter_any():
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
+    def _make_ctx(self, body: Dict, request: web.Request) -> RequestCtx:
+        prompt = body.get("prompt")
+        token_ids = None
+        text = ""
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            token_ids = prompt
+        elif prompt is not None:
+            text = str(prompt)
+        elif "messages" in body:
+            text = "".join(m.get("content", "")
+                           for m in body.get("messages", []))
+        return RequestCtx(body=body, prompt_text=text, token_ids=token_ids,
+                          headers={},
+                          request_id=body.get("request_id", ""))
+
+
+def build_gateway(
+    endpoints: List[EndpointState],
+    config_yaml: Optional[str] = None,
+    scrape_interval_s: float = 0.2,
+    kv_events_bind: Optional[str] = None,
+    indexer: Optional[PrefixIndex] = None,
+) -> Gateway:
+    config = parse_config(config_yaml or DEFAULT_CONFIG_YAML)
+    datastore = Datastore(endpoints, scrape_interval_s=scrape_interval_s)
+    metrics = EppMetrics()
+    needs_index = any(p.type == "precise-prefix-cache-scorer"
+                      for p in config.plugins)
+    subscriber = None
+    if indexer is None and needs_index:
+        indexer = PrefixIndex(metrics=metrics)
+    if indexer is not None and kv_events_bind:
+        subscriber = ZmqEventSubscriber(indexer, bind=kv_events_bind)
+    scheduler = EppScheduler(config, datastore, metrics=metrics,
+                             indexer=indexer)
+    return Gateway(scheduler, datastore, subscriber=subscriber)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser("llmd-gateway")
+    p.add_argument("--endpoints", required=True,
+                   help="comma list of host:port[=role]; role in "
+                        "prefill|decode|both")
+    p.add_argument("--config", default=None,
+                   help="EndpointPickerConfig YAML path (default: queue + "
+                        "kv-util + prefix scorers, max-score picker)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--scrape-interval", type=float, default=0.2)
+    p.add_argument("--kv-events-bind", default=None,
+                   help="ZMQ bind for engine KV events, e.g. tcp://*:5557 "
+                        "(enables the precise prefix index)")
+    args = p.parse_args(argv)
+
+    config_yaml = None
+    if args.config:
+        with open(args.config) as f:
+            config_yaml = f.read()
+    endpoints = [parse_endpoint_arg(e)
+                 for e in args.endpoints.split(",") if e.strip()]
+    gw = build_gateway(endpoints, config_yaml,
+                       scrape_interval_s=args.scrape_interval,
+                       kv_events_bind=args.kv_events_bind)
+    logging.basicConfig(level=logging.INFO)
+    web.run_app(gw.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

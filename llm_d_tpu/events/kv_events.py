@@ -1,0 +1,119 @@
+"""Engine-side KV-cache event publisher (vLLM KV-events equivalent).
+
+Attaches to ``KVCacheManager``'s block hooks and publishes batched
+BlockStored / BlockRemoved events so the EPP's precise prefix index tracks
+which replica holds which prefix blocks (reference engine config:
+``--kv-events-config '{"publisher":"zmq","endpoint":"tcp://<epp>:5557",
+"topic":"kv@$POD_IP@<model>"}'``, ms-kv-events/values.yaml:40).
+
+Events batch on a short flush interval so the decode hot loop never blocks
+on the network; the publisher thread owns the ZMQ socket.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class ZmqKvEventPublisher:
+    def __init__(
+        self,
+        endpoint: str,              # e.g. "tcp://epp-host:5557"
+        pod_identity: str,          # this replica's address, e.g. "10.0.0.3:8200"
+        model: str = "model",
+        flush_interval_s: float = 0.05,
+        max_batch: int = 512,
+    ) -> None:
+        self.endpoint = endpoint
+        self.topic = f"kv@{pod_identity}@{model}".encode()
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self._q: "queue.Queue[Tuple[str, bytes]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------- KVCacheManager hook surface ----------
+
+    def on_block_stored(self, block_hash: bytes, block_id: int) -> None:
+        self._q.put(("BlockStored", block_hash))
+
+    def on_block_removed(self, block_hash: bytes, block_id: int) -> None:
+        self._q.put(("BlockRemoved", block_hash))
+
+    def attach(self, kv_manager) -> None:
+        kv_manager.on_block_stored.append(self.on_block_stored)
+        kv_manager.on_block_removed.append(self.on_block_removed)
+
+    # ---------- publisher thread ----------
+
+    def start(self) -> None:
+        import zmq
+
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.PUB)
+        sock.connect(self.endpoint)
+        self._sock = sock
+        self._thread = threading.Thread(
+            target=self._loop, name="kv-event-pub", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import msgpack
+
+        while not self._stop.is_set():
+            time.sleep(self.flush_interval_s)
+            events: List[Tuple[str, bytes]] = []
+            while len(events) < self.max_batch:
+                try:
+                    events.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            if not events:
+                continue
+            # Coalesce consecutive same-type events into one batch entry.
+            grouped: List[dict] = []
+            for etype, h in events:
+                if grouped and grouped[-1]["type"] == etype:
+                    grouped[-1]["block_hashes"].append(h)
+                else:
+                    grouped.append({"type": etype, "block_hashes": [h]})
+            payload = msgpack.packb(
+                {"ts": time.time(), "events": grouped})
+            try:
+                self._sock.send_multipart([self.topic, payload])
+            except Exception:
+                logger.exception("kv-event publish failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        try:
+            self._sock.close(0)
+        except Exception:
+            pass
+
+
+class InprocKvEventSink:
+    """Same-process event path: feeds a ``PrefixIndex`` directly (tests and
+    single-process all-in-one deployments; no sockets)."""
+
+    def __init__(self, index, pod_identity: str) -> None:
+        self.index = index
+        self.pod_identity = pod_identity
+
+    def on_block_stored(self, block_hash: bytes, block_id: int) -> None:
+        self.index.on_event(self.pod_identity, "BlockStored", [block_hash])
+
+    def on_block_removed(self, block_hash: bytes, block_id: int) -> None:
+        self.index.on_event(self.pod_identity, "BlockRemoved", [block_hash])
+
+    def attach(self, kv_manager) -> None:
+        kv_manager.on_block_stored.append(self.on_block_stored)
+        kv_manager.on_block_removed.append(self.on_block_removed)

@@ -87,6 +87,9 @@ class ModelServer:
 
     async def _on_cleanup(self, app) -> None:
         self.async_engine.stop()
+        pub = getattr(self, "kv_event_publisher", None)
+        if pub is not None:
+            pub.stop()
 
     # ---------- probes / meta ----------
 
@@ -281,6 +284,15 @@ def main(argv: Optional[List[str]] = None) -> None:
         "--allow-device-subset", action="store_true",
         help="permit a mesh smaller than the host's device count "
              "(deliberately idle chips); default is to fail fast")
+    p.add_argument(
+        "--kv-events-endpoint", default=None,
+        help="ZMQ endpoint of the EPP's KV-event sink (e.g. "
+             "tcp://epp-host:5557); enables precise prefix routing "
+             "(reference: --kv-events-config, ms-kv-events/values.yaml:40)")
+    p.add_argument(
+        "--pod-identity", default=None,
+        help="this replica's address as the EPP sees it (host:port); "
+             "defaults to <host>:<port>")
     args = p.parse_args(argv)
 
     from llm_d_tpu.parallel.mesh import MeshConfig
@@ -293,6 +305,26 @@ def main(argv: Optional[List[str]] = None) -> None:
         if args.tensor_parallel_size * args.data_parallel_size > 1 else None,
         allow_device_subset=args.allow_device_subset)
     server = build_server(cfg, args.tokenizer)
+    if args.kv_events_endpoint:
+        from llm_d_tpu.events.kv_events import ZmqKvEventPublisher
+        identity = args.pod_identity
+        if not identity:
+            # The EPP keys its prefix index by the endpoint address it
+            # routes to — a wildcard bind address would never match.
+            host = args.host
+            if host in ("0.0.0.0", "::", ""):
+                import socket as _socket
+                host = _socket.gethostbyname(_socket.gethostname())
+                logger.warning(
+                    "kv-events: --pod-identity not set and --host is a "
+                    "wildcard; guessing %s:%s (set --pod-identity to the "
+                    "address the EPP routes to)", host, args.port)
+            identity = f"{host}:{args.port}"
+        publisher = ZmqKvEventPublisher(
+            args.kv_events_endpoint, identity, model=args.model)
+        publisher.attach(server.engine.kv_manager)
+        publisher.start()
+        server.kv_event_publisher = publisher
     logging.basicConfig(level=logging.INFO)
     web.run_app(server.build_app(), host=args.host, port=args.port)
 

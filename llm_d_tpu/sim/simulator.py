@@ -1,0 +1,321 @@
+"""Accelerator-free inference simulator (llm-d-inference-sim equivalent).
+
+A fake model server with the REAL API surface — OpenAI endpoints, the
+three-probe readiness contract, and the ``vllm:*`` metric taxonomy — but no
+engine: responses are synthesized at configurable TTFT/TPOT.  The reference
+uses exactly such a component to scale-test the scheduler and autoscaler "in
+wide or dense configurations on CPU-only machines" (reference:
+guides/simulated-accelerators/README.md:5-7, ms-sim/values.yaml:26).
+
+The simulator models the load signals the EPP scores on:
+  - ``vllm:num_requests_running`` / ``vllm:num_requests_waiting`` via a
+    bounded running-slot pool (``max_num_seqs``);
+  - ``vllm:kv_cache_usage_perc`` from simulated KV blocks held by active
+    requests (prompt+output tokens / block_size against ``num_blocks``);
+  - a prefix cache with the engine's real chain hashing
+    (``llm_d_tpu.utils.hashing``) feeding ``vllm:prefix_cache_*`` and
+    optional KV events for the precise-prefix scorer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+import uuid as uuid_mod
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from llm_d_tpu.utils.hashing import hash_token_blocks
+from llm_d_tpu.utils.metrics import EngineMetrics
+
+logger = logging.getLogger(__name__)
+
+_LOREM = ("the quick brown fox jumps over the lazy dog and runs far away "
+          "into deep green woods while rain falls soft on old stone walls "
+          ).split()
+
+
+class SimConfig:
+    def __init__(
+        self,
+        model: str = "sim-model",
+        ttft_ms: float = 50.0,
+        tpot_ms: float = 10.0,
+        max_num_seqs: int = 64,
+        num_blocks: int = 1024,
+        block_size: int = 64,
+        startup_delay_s: float = 0.0,
+        seed: int = 0,
+    ) -> None:
+        self.model = model
+        self.ttft_ms = ttft_ms
+        self.tpot_ms = tpot_ms
+        self.max_num_seqs = max_num_seqs
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.startup_delay_s = startup_delay_s
+        self.seed = seed
+
+
+class InferenceSimulator:
+    """State machine behind the endpoints; no accelerator anywhere."""
+
+    def __init__(self, config: SimConfig,
+                 kv_event_sink=None) -> None:
+        self.config = config
+        self.metrics = EngineMetrics(config.model)
+        self.started_at = time.time()
+        self.model_loaded = False
+        self._running = 0
+        self._waiting = 0
+        self._blocks_used = 0          # simulated KV blocks held
+        self._slots = asyncio.Semaphore(config.max_num_seqs)
+        # Prefix "cache": block hash -> last-touch time (LRU by re-insert).
+        self._cached_blocks: Dict[bytes, float] = {}
+        # Optional callable(event_type, block_hashes) for KV events
+        # (the ZMQ publisher hooks in here).
+        self.kv_event_sink = kv_event_sink
+
+    # ---------- token accounting ----------
+
+    def _tokenize(self, prompt: str) -> List[int]:
+        # Deterministic cheap "tokenizer": one token per 4 chars.
+        data = prompt.encode()
+        return [int.from_bytes(data[i:i + 2], "little") % 50000
+                for i in range(0, max(len(data), 1), 4)]
+
+    def _update_gauges(self) -> None:
+        self.metrics.num_requests_running.set(self._running)
+        self.metrics.num_requests_waiting.set(self._waiting)
+        usable = self.config.num_blocks
+        self.metrics.kv_cache_usage_perc.set(
+            min(1.0, self._blocks_used / usable if usable else 0.0))
+
+    def _prefix_hit_tokens(self, token_ids: List[int]) -> int:
+        hashes = hash_token_blocks(token_ids, self.config.block_size)
+        hits = 0
+        for h in hashes:
+            if h in self._cached_blocks:
+                hits += 1
+            else:
+                break
+        return hits * self.config.block_size
+
+    def _store_prefix(self, token_ids: List[int]) -> None:
+        hashes = hash_token_blocks(token_ids, self.config.block_size)
+        # LRU capacity = num_blocks entries; evict oldest beyond it.
+        now = time.monotonic()
+        stored = []
+        for h in hashes:
+            if h not in self._cached_blocks:
+                stored.append(h)
+            self._cached_blocks[h] = now
+        while len(self._cached_blocks) > self.config.num_blocks:
+            oldest = min(self._cached_blocks, key=self._cached_blocks.get)
+            del self._cached_blocks[oldest]
+            if self.kv_event_sink:
+                self.kv_event_sink("BlockRemoved", [oldest])
+        if stored and self.kv_event_sink:
+            self.kv_event_sink("BlockStored", stored)
+
+    # ---------- request lifecycle ----------
+
+    async def run_request(self, prompt_ids: List[int], max_tokens: int):
+        """Yields (token_text, is_first) at the simulated rate."""
+        c = self.config
+        arrival = time.monotonic()
+        self._waiting += 1
+        self._update_gauges()
+        async with self._slots:
+            self._waiting -= 1
+            self._running += 1
+            n_blocks = (len(prompt_ids) + max_tokens) // c.block_size + 1
+            self._blocks_used += n_blocks
+            self._update_gauges()
+            try:
+                cached = self._prefix_hit_tokens(prompt_ids)
+                self.metrics.prefix_cache_queries.inc(len(prompt_ids))
+                if cached:
+                    self.metrics.prefix_cache_hits.inc(
+                        min(cached, len(prompt_ids)))
+                # TTFT scales down with prefix-cache hits (the signal the
+                # prefix scorers exploit).
+                miss_frac = 1.0 - min(cached, len(prompt_ids)) / max(
+                    1, len(prompt_ids))
+                await asyncio.sleep(c.ttft_ms / 1e3 * max(miss_frac, 0.1))
+                self.metrics.prompt_tokens.inc(len(prompt_ids))
+                self.metrics.time_to_first_token.observe(
+                    time.monotonic() - arrival)
+                self._store_prefix(prompt_ids)
+                for i in range(max_tokens):
+                    if i > 0:
+                        await asyncio.sleep(c.tpot_ms / 1e3)
+                        self.metrics.inter_token_latency.observe(c.tpot_ms / 1e3)
+                    word = _LOREM[(len(prompt_ids) + i) % len(_LOREM)]
+                    self.metrics.generation_tokens.inc()
+                    yield (word + " ", i == 0)
+                self.metrics.request_success.labels(
+                    model_name=self.config.model,
+                    finished_reason="length").inc()
+                self.metrics.e2e_request_latency.observe(
+                    time.monotonic() - arrival)
+            finally:
+                self._running -= 1
+                self._blocks_used -= n_blocks
+                self._update_gauges()
+
+
+class SimServer:
+    """HTTP surface identical to the real model server's contract."""
+
+    def __init__(self, sim: InferenceSimulator) -> None:
+        self.sim = sim
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.on_startup.append(self._on_startup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        async def load():
+            await asyncio.sleep(self.sim.config.startup_delay_s)
+            self.sim.model_loaded = True
+        asyncio.get_running_loop().create_task(load())
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def models(self, request: web.Request) -> web.Response:
+        if not self.sim.model_loaded:
+            return web.json_response({"error": "model loading"}, status=503)
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.sim.config.model, "object": "model",
+                      "created": int(self.sim.started_at),
+                      "owned_by": "llm-d-tpu-sim"}],
+        })
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.sim.metrics.render(),
+                            content_type="text/plain")
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._run(request, chat=False)
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._run(request, chat=True)
+
+    async def _run(self, http_req: web.Request, chat: bool) -> web.StreamResponse:
+        try:
+            body = await http_req.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid json"}, status=400)
+        if chat:
+            prompt = "".join(m.get("content", "")
+                             for m in body.get("messages", []))
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = " ".join(map(str, prompt))
+        prompt_ids = self.sim._tokenize(str(prompt))
+        max_tokens = int(body.get("max_tokens",
+                                  body.get("max_completion_tokens", 16)))
+        rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
+        created = int(time.time())
+        stream = bool(body.get("stream", False))
+        model = self.sim.config.model
+
+        if stream:
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache"})
+            await resp.prepare(http_req)
+            i = 0
+            async for text, _first in self.sim.run_request(
+                    prompt_ids, max_tokens):
+                i += 1
+                finished = i == max_tokens
+                choice: Dict[str, Any] = {
+                    "index": 0,
+                    "finish_reason": "length" if finished else None}
+                if chat:
+                    choice["delta"] = {"content": text}
+                else:
+                    choice["text"] = text
+                chunk = {"id": rid, "created": created, "model": model,
+                         "object": ("chat.completion.chunk" if chat
+                                    else "text_completion"),
+                         "choices": [choice]}
+                await resp.write(b"data: " + json.dumps(chunk).encode()
+                                 + b"\n\n")
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+
+        parts: List[str] = []
+        async for text, _first in self.sim.run_request(prompt_ids, max_tokens):
+            parts.append(text)
+        full = "".join(parts)
+        payload = {
+            "id": rid,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": created,
+            "model": model,
+            "choices": [{
+                "index": 0,
+                "finish_reason": "length",
+                **({"message": {"role": "assistant", "content": full}}
+                   if chat else {"text": full}),
+            }],
+            "usage": {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": max_tokens,
+                "total_tokens": len(prompt_ids) + max_tokens,
+            },
+        }
+        return web.json_response(payload)
+
+
+def build_sim_server(config: Optional[SimConfig] = None,
+                     kv_event_sink=None) -> SimServer:
+    return SimServer(InferenceSimulator(config or SimConfig(),
+                                        kv_event_sink=kv_event_sink))
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser("llmd-sim")
+    p.add_argument("--model", default="sim-model")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--time-to-first-token", type=float, default=50.0,
+                   help="simulated TTFT in ms")
+    p.add_argument("--inter-token-latency", type=float, default=10.0,
+                   help="simulated TPOT in ms")
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--num-blocks", type=int, default=1024)
+    p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--startup-delay", type=float, default=0.0,
+                   help="seconds before /v1/models turns ready")
+    args = p.parse_args(argv)
+
+    cfg = SimConfig(
+        model=args.model, ttft_ms=args.time_to_first_token,
+        tpot_ms=args.inter_token_latency, max_num_seqs=args.max_num_seqs,
+        num_blocks=args.num_blocks, block_size=args.block_size,
+        startup_delay_s=args.startup_delay)
+    logging.basicConfig(level=logging.INFO)
+    web.run_app(build_sim_server(cfg).build_app(),
+                host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,362 @@
+"""EPP scheduler: config parsing, plugin scoring, profile handling, and the
+e2e multi-replica routing contract (prefix-affine requests land on the warm
+replica via x-gateway-destination-endpoint) against simulator backends.
+
+Reference behavior being mirrored: gaie values plugin configs (SURVEY.md
+§2.4), EPP decision header (standalone values.yaml:170-181), KV-event-fed
+precise prefix scoring (gaie-kv-events/values.yaml:42-70).
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from llm_d_tpu.epp.config import DEFAULT_CONFIG_YAML, parse_config
+from llm_d_tpu.epp.datastore import Datastore, EndpointState
+from llm_d_tpu.epp.indexer import PrefixIndex
+from llm_d_tpu.epp.plugins import (
+    KvCacheUtilizationScorer,
+    PdProfileHandler,
+    PrefixCacheScorer,
+    QueueScorer,
+    RequestCtx,
+)
+from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
+from llm_d_tpu.utils.metrics import EppMetrics
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def make_datastore(n=3, role="both"):
+    eps = [EndpointState(address=f"10.0.0.{i}:8200", role=role,
+                         ready=True) for i in range(n)]
+    return Datastore(eps, scrape_interval_s=999)
+
+
+# ---------- config ----------
+
+def test_parse_default_config():
+    cfg = parse_config(DEFAULT_CONFIG_YAML)
+    types = {p.type for p in cfg.plugins}
+    assert "queue-scorer" in types and "max-score-picker" in types
+    prof = cfg.profile("default")
+    weights = {r.plugin_ref: r.weight for r in prof.plugins}
+    assert weights["prefix-cache-scorer"] == 3.0
+    assert weights["queue-scorer"] == 2.0
+
+
+def test_parse_named_plugin_instances():
+    cfg = parse_config("""
+kind: EndpointPickerConfig
+plugins:
+- type: prefix-cache-scorer
+  name: gpu-prefix-scorer
+  parameters: {lruCapacityPerServer: 100}
+- type: prefix-cache-scorer
+  name: cpu-prefix-scorer
+  parameters: {lruCapacityPerServer: 41000}
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: gpu-prefix-scorer
+    weight: 2
+  - pluginRef: cpu-prefix-scorer
+    weight: 1
+  - pluginRef: max-score-picker
+""")
+    assert cfg.plugin("gpu-prefix-scorer").parameters[
+        "lruCapacityPerServer"] == 100
+    assert cfg.plugin("cpu-prefix-scorer").parameters[
+        "lruCapacityPerServer"] == 41000
+
+
+# ---------- scorers ----------
+
+def test_queue_scorer_prefers_empty_queue():
+    ds = make_datastore()
+    eps = ds.candidates()
+    eps[0].num_waiting = 10
+    eps[1].num_waiting = 0
+    eps[2].num_waiting = 5
+    scores = QueueScorer("q", {}, ds).score(RequestCtx(body={}), eps)
+    assert scores[eps[1].address] == 1.0
+    assert scores[eps[0].address] == 0.0
+
+
+def test_kv_util_scorer():
+    ds = make_datastore()
+    eps = ds.candidates()
+    eps[0].kv_usage = 0.9
+    eps[1].kv_usage = 0.1
+    scores = KvCacheUtilizationScorer("kv", {}, ds).score(
+        RequestCtx(body={}), eps)
+    assert scores[eps[1].address] > scores[eps[0].address]
+    assert abs(scores[eps[1].address] - 0.9) < 1e-9
+
+
+def test_prefix_scorer_learns_routing():
+    ds = make_datastore()
+    eps = ds.candidates()
+    sc = PrefixCacheScorer("p", {"hashBlockSize": 4}, ds)
+    ctx = RequestCtx(body={}, token_ids=list(range(16)))
+    assert all(v == 0.0 for v in sc.score(ctx, eps).values())
+    sc.on_picked(ctx, eps[1], "default")
+    scores = sc.score(ctx, eps)
+    assert scores[eps[1].address] == 1.0
+    assert scores[eps[0].address] == 0.0
+    # Shared 8-token prefix -> half the blocks match.
+    ctx2 = RequestCtx(body={}, token_ids=list(range(8)) + [99] * 8)
+    assert sc.score(ctx2, eps)[eps[1].address] == pytest.approx(0.5)
+
+
+def test_precise_prefix_index_and_scorer():
+    from llm_d_tpu.epp.plugins import PrecisePrefixCacheScorer
+    idx = PrefixIndex()
+    ds = make_datastore()
+    eps = ds.candidates()
+    ctx = RequestCtx(body={}, token_ids=list(range(128)))
+    keys = ctx.block_keys(64)
+    idx.on_event(eps[2].address, "BlockStored", keys)
+    sc = PrecisePrefixCacheScorer("pp", {"blockSize": 64}, ds, indexer=idx)
+    scores = sc.score(ctx, eps)
+    assert scores[eps[2].address] == 1.0
+    assert scores[eps[0].address] == 0.0
+    # Removal drops residency.
+    idx.on_event(eps[2].address, "BlockRemoved", keys)
+    assert sc.score(ctx, eps)[eps[2].address] == 0.0
+
+
+# ---------- profiles / scheduler ----------
+
+def test_pd_profile_handler_threshold():
+    ds = make_datastore()
+    h = PdProfileHandler("pd", {"threshold": 10}, ds, metrics=EppMetrics())
+    short = RequestCtx(body={}, token_ids=[1] * 5)
+    long = RequestCtx(body={}, token_ids=[1] * 50)
+    assert h.profiles(short, ["prefill", "decode"]) == ["decode"]
+    assert h.profiles(long, ["prefill", "decode"]) == ["prefill", "decode"]
+
+
+def test_scheduler_picks_least_loaded():
+    cfg = parse_config("""
+kind: EndpointPickerConfig
+plugins:
+- type: single-profile-handler
+- type: queue-scorer
+- type: kv-cache-utilization-scorer
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: queue-scorer
+    weight: 2
+  - pluginRef: kv-cache-utilization-scorer
+    weight: 2
+  - pluginRef: max-score-picker
+""")
+    ds = make_datastore()
+    eps = ds.candidates()
+    eps[0].num_waiting, eps[0].kv_usage = 8, 0.8
+    eps[1].num_waiting, eps[1].kv_usage = 0, 0.1
+    eps[2].num_waiting, eps[2].kv_usage = 4, 0.5
+    sched = EppScheduler(cfg, ds)
+    result = sched.schedule(RequestCtx(body={}, prompt_text="hello"))
+    assert result.primary.address == eps[1].address
+    assert result.headers[DESTINATION_HEADER] == eps[1].address
+
+
+def test_pd_scheduler_sets_prefill_header():
+    cfg = parse_config("""
+kind: EndpointPickerConfig
+plugins:
+- type: pd-profile-handler
+  parameters: {threshold: 0}
+- type: prefill-header-handler
+- type: prefill-filter
+- type: decode-filter
+- type: queue-scorer
+- type: max-score-picker
+schedulingProfiles:
+- name: prefill
+  plugins:
+  - pluginRef: prefill-filter
+  - pluginRef: queue-scorer
+  - pluginRef: max-score-picker
+- name: decode
+  plugins:
+  - pluginRef: decode-filter
+  - pluginRef: queue-scorer
+  - pluginRef: max-score-picker
+""")
+    eps = [EndpointState(address="10.0.0.1:8000", role="prefill", ready=True),
+           EndpointState(address="10.0.0.2:8000", role="decode", ready=True)]
+    ds = Datastore(eps, scrape_interval_s=999)
+    sched = EppScheduler(cfg, ds)
+    result = sched.schedule(RequestCtx(body={}, token_ids=[1] * 64))
+    assert result.picks["prefill"].address == "10.0.0.1:8000"
+    assert result.picks["decode"].address == "10.0.0.2:8000"
+    assert result.primary.address == "10.0.0.2:8000"   # decode serves
+    assert result.headers["x-prefiller-host-port"] == "10.0.0.1:8000"
+    assert result.headers[DESTINATION_HEADER] == "10.0.0.2:8000"
+
+
+# ---------- e2e: gateway + 3 simulator replicas ----------
+
+async def _start_app(app, port):
+    from aiohttp import web
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner
+
+
+def test_gateway_e2e_prefix_affinity_routing():
+    """VERDICT r2 'done' bar: 3 replicas; prefix-affine requests
+    demonstrably route to the warm replica via the destination header."""
+    from llm_d_tpu.epp.service import build_gateway
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    async def run():
+        sim_ports = [free_port() for _ in range(3)]
+        runners = []
+        for i, port in enumerate(sim_ports):
+            srv = build_sim_server(SimConfig(
+                model=f"sim-{i}", ttft_ms=1.0, tpot_ms=0.2))
+            runners.append(await _start_app(srv.build_app(), port))
+
+        endpoints = [EndpointState(address=f"127.0.0.1:{p}")
+                     for p in sim_ports]
+        gw = build_gateway(endpoints, scrape_interval_s=0.05)
+        gw_port = free_port()
+        runners.append(await _start_app(gw.build_app(), gw_port))
+
+        import aiohttp
+        async with aiohttp.ClientSession() as sess:
+            # Wait for first scrape to mark endpoints ready.
+            for _ in range(50):
+                if all(e.ready for e in gw.datastore.candidates()):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(e.ready for e in gw.datastore.candidates())
+
+            async def post(prompt):
+                async with sess.post(
+                        f"http://127.0.0.1:{gw_port}/v1/completions",
+                        json={"prompt": prompt, "max_tokens": 4}) as r:
+                    assert r.status == 200, await r.text()
+                    dest = r.headers[DESTINATION_HEADER]
+                    await r.json()
+                    return dest
+
+            prompt_a = "alpha " * 200     # long enough for several blocks
+            prompt_b = "omega " * 200
+            dest_a = await post(prompt_a)
+            dest_b = None
+            # Route B somewhere; retry until it lands off A's replica (the
+            # first B request has no prefix affinity anywhere, so scores tie
+            # across replicas and the picker breaks ties randomly).
+            for _ in range(20):
+                dest_b = await post(prompt_b)
+                if dest_b != dest_a:
+                    break
+            # Warm affinity: repeats must stick to their replica.
+            for _ in range(5):
+                assert await post(prompt_a) == dest_a
+                assert await post(prompt_b) == dest_b
+
+            # Scheduler metrics exposed.
+            async with sess.get(
+                    f"http://127.0.0.1:{gw_port}/metrics") as r:
+                text = await r.text()
+            assert "inference_extension_scheduler_e2e_duration_seconds" in text
+
+        for r in runners:
+            await r.cleanup()
+
+    asyncio.run(run())
+
+
+def test_gateway_e2e_sim_metrics_surface():
+    """Simulator exposes the vllm:* surface the EPP scrapes."""
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    async def run():
+        port = free_port()
+        srv = build_sim_server(SimConfig(ttft_ms=1.0, tpot_ms=0.2))
+        runner = await _start_app(srv.build_app(), port)
+        import aiohttp
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(f"http://127.0.0.1:{port}/health") as r:
+                assert r.status == 200
+            async with sess.get(f"http://127.0.0.1:{port}/v1/models") as r:
+                assert r.status == 200
+            async with sess.post(
+                    f"http://127.0.0.1:{port}/v1/completions",
+                    json={"prompt": "hello world", "max_tokens": 3}) as r:
+                body = await r.json()
+                assert body["usage"]["completion_tokens"] == 3
+                assert body["choices"][0]["text"]
+            # Streaming chat.
+            async with sess.post(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    json={"messages": [{"role": "user", "content": "hi"}],
+                          "max_tokens": 2, "stream": True}) as r:
+                text = await r.text()
+                assert "data: [DONE]" in text
+            async with sess.get(f"http://127.0.0.1:{port}/metrics") as r:
+                m = await r.text()
+            for metric in ("vllm:num_requests_running",
+                           "vllm:kv_cache_usage_perc",
+                           "vllm:generation_tokens_total",
+                           "vllm:time_to_first_token_seconds"):
+                assert metric in m, metric
+        await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_zmq_kv_event_roundtrip():
+    """Engine publisher -> ZMQ -> EPP subscriber -> prefix index."""
+    import time as _time
+
+    from llm_d_tpu.engine.kv_cache import KVCacheManager
+    from llm_d_tpu.events.kv_events import ZmqKvEventPublisher
+
+    port = free_port()
+    idx = PrefixIndex()
+    from llm_d_tpu.epp.indexer import ZmqEventSubscriber
+    sub = ZmqEventSubscriber(idx, bind=f"tcp://127.0.0.1:{port}")
+    sub.start()
+
+    pub = ZmqKvEventPublisher(f"tcp://127.0.0.1:{port}",
+                              pod_identity="10.9.9.9:8200", model="m",
+                              flush_interval_s=0.02)
+    kv = KVCacheManager(num_blocks=16, block_size=4)
+    pub.attach(kv)
+    pub.start()
+    _time.sleep(0.3)    # PUB/SUB join
+
+    from llm_d_tpu.engine.request import Request
+    from llm_d_tpu.ops.sampling import SamplingParams
+    req = Request(request_id="r1", prompt_token_ids=list(range(12)),
+                  sampling=SamplingParams())
+    kv.allocate(req, 12)
+    req.num_computed_tokens = 12
+    kv.cache_full_blocks(req)
+
+    deadline = _time.time() + 5
+    keys = kv.request_block_hashes(req)
+    while _time.time() < deadline:
+        if idx.longest_prefix(keys, "10.9.9.9:8200") == 3:
+            break
+        _time.sleep(0.05)
+    assert idx.longest_prefix(keys, "10.9.9.9:8200") == 3
+    pub.stop()
+    sub.stop()
