@@ -1,0 +1,200 @@
+"""Data-parallel engine group: per-rank engine cores + local dispatcher.
+
+The reference's DP is not one SPMD program over a dp axis — it is N
+independent vLLM engine cores (one per rank, each with its own scheduler
+and KV cache) behind a local load balancer (``--data-parallel-size``,
+``--data-parallel-hybrid-lb``; reference: wide-ep decode.yaml:73-93).  This
+module is that shape on TPU: each rank owns a disjoint tp-submesh of the
+host's chips, so a dp=4 group really does 1/4 the per-device attention
+FLOPs and holds 1/4 of the sequences' KV per rank — no replicated compute.
+
+Dispatch policy is least-outstanding-work (waiting + running sequences),
+the engine-level analogue of the EPP's queue scorer; cross-replica
+prefix-affinity stays the EPP's job (it sees all replicas, we see one
+pod's ranks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import jax
+
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+from llm_d_tpu.engine.request import Request, RequestOutput
+from llm_d_tpu.parallel.mesh import MeshConfig
+from llm_d_tpu.utils.metrics import EngineMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class DPEngineGroup:
+    """EngineCore-compatible facade over ``dp`` per-rank engine cores."""
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        dp_size: int,
+        params=None,
+        metrics: Optional[EngineMetrics] = None,
+        devices: Optional[List[jax.Device]] = None,
+    ) -> None:
+        assert dp_size >= 1
+        tp = config.mesh.tp if config.mesh else 1
+        sp = config.mesh.sp if config.mesh else 1
+        devices = list(devices if devices is not None else jax.devices())
+        per_rank = tp * sp
+        if dp_size * per_rank != len(devices) and not config.allow_device_subset:
+            raise ValueError(
+                f"dp={dp_size} x tp={tp} x sp={sp} needs "
+                f"{dp_size * per_rank} devices, host has {len(devices)} "
+                f"(pass allow_device_subset to idle chips deliberately)")
+        self.config = config
+        self.model_config = config.resolve_model()
+        self.metrics = metrics or EngineMetrics(self.model_config.name)
+        self.engines: List[EngineCore] = []
+        for r in range(dp_size):
+            rank_cfg = dataclasses.replace(
+                config,
+                mesh=MeshConfig(dp=1, sp=sp, tp=tp) if per_rank > 1 else None,
+                allow_device_subset=True)
+            rank_devices = devices[r * per_rank:(r + 1) * per_rank]
+            engine = EngineCore(rank_cfg, params=params, metrics=self.metrics,
+                                devices=rank_devices)
+            self.engines.append(engine)
+        self._rank_of: Dict[str, int] = {}
+        # Ranks step concurrently: their device programs run on disjoint
+        # chips, so serializing them on one thread would make per-step
+        # latency grow linearly with dp and let one rank's prefill
+        # head-of-line-block every other rank's decodes.
+        self._pool = (ThreadPoolExecutor(
+            max_workers=dp_size, thread_name_prefix="dp-rank")
+            if dp_size > 1 else None)
+
+    # ---------- EngineCore-compatible surface ----------
+
+    @property
+    def tokenizer(self):
+        return self.engines[0].tokenizer
+
+    @tokenizer.setter
+    def tokenizer(self, tok) -> None:
+        for e in self.engines:
+            e.tokenizer = tok
+
+    @property
+    def eos_token_id(self):
+        return self.engines[0].eos_token_id
+
+    @eos_token_id.setter
+    def eos_token_id(self, tid) -> None:
+        for e in self.engines:
+            e.eos_token_id = tid
+
+    @property
+    def kv_manager(self):
+        # KV events / offload hooks attach per rank; expose rank 0 for
+        # single-rank compatibility and ``kv_managers`` for the rest.
+        return self.engines[0].kv_manager
+
+    @property
+    def kv_managers(self):
+        return [e.kv_manager for e in self.engines]
+
+    @property
+    def kv_connector(self):
+        return self.engines[0].kv_connector
+
+    @kv_connector.setter
+    def kv_connector(self, conn) -> None:
+        if conn is not None and len(self.engines) > 1:
+            # Each rank needs its own transfer server/completion pump; a
+            # shared connector would admit rank A's pulls into rank B.
+            raise NotImplementedError(
+                "PD connector on a dp>1 group: construct one connector per "
+                "rank and assign engines[i].kv_connector directly")
+        self.engines[0].kv_connector = conn
+
+    @property
+    def scheduler(self):
+        """AsyncEngine's idle probe; a facade aggregating all ranks."""
+        return _SchedulerView(self.engines)
+
+    # ---------- dispatch ----------
+
+    def _pick_rank(self) -> int:
+        loads = [e.scheduler.num_waiting + e.scheduler.num_running
+                 for e in self.engines]
+        return loads.index(min(loads))
+
+    def add_request(self, request: Request) -> None:
+        rank = self._pick_rank()
+        self._rank_of[request.request_id] = rank
+        self.engines[rank].add_request(request)
+
+    def abort_request(self, request_id: str) -> None:
+        rank = self._rank_of.get(request_id)
+        if rank is None:
+            for e in self.engines:
+                e.abort_request(request_id)
+        else:
+            self.engines[rank].abort_request(request_id)
+
+    def has_work(self) -> bool:
+        return any(e.has_work() for e in self.engines)
+
+    def step(self) -> List[RequestOutput]:
+        outputs: List[RequestOutput] = []
+        busy = [e for e in self.engines if e.has_work()]
+        if self._pool is not None and len(busy) > 1:
+            for outs in self._pool.map(lambda e: e.step(), busy):
+                outputs.extend(outs)
+        else:
+            for e in busy:
+                outputs.extend(e.step())
+        for out in outputs:
+            if out.finished:
+                self._rank_of.pop(out.request_id, None)
+        self._update_gauges()
+        return outputs
+
+    def _update_gauges(self) -> None:
+        """Aggregate gauges across ranks (each rank's step overwrote them)."""
+        self.metrics.num_requests_waiting.set(
+            sum(e.scheduler.num_waiting for e in self.engines))
+        self.metrics.num_requests_running.set(
+            sum(e.scheduler.num_running for e in self.engines))
+        self.metrics.kv_cache_usage_perc.set(
+            sum(e.kv_manager.usage for e in self.engines) / len(self.engines))
+
+    def generate(self, requests: List[Request], max_steps: int = 10000
+                 ) -> Dict[str, List[int]]:
+        for r in requests:
+            self.add_request(r)
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            self.step()
+            if not self.scheduler.has_work() and self.has_work():
+                time.sleep(0.001)
+        return {r.request_id: list(r.output_token_ids) for r in requests}
+
+
+class _SchedulerView:
+    def __init__(self, engines: List[EngineCore]) -> None:
+        self._engines = engines
+
+    def has_work(self) -> bool:
+        return any(e.scheduler.has_work() for e in self._engines)
+
+    @property
+    def num_waiting(self) -> int:
+        return sum(e.scheduler.num_waiting for e in self._engines)
+
+    @property
+    def num_running(self) -> int:
+        return sum(e.scheduler.num_running for e in self._engines)

@@ -78,15 +78,20 @@ class EngineCore:
         config: EngineConfig,
         params: Optional[Any] = None,
         metrics: Optional[EngineMetrics] = None,
+        devices: Optional[List[jax.Device]] = None,
     ) -> None:
+        """``devices`` pins this core to a device subset — the DP group gives
+        each rank a disjoint tp-submesh (reference: per-rank engine cores,
+        decode.yaml:73-93)."""
         self.config = config
         self.model_config = config.resolve_model()
         c = self.model_config
 
-        self.mesh = (make_mesh(config.mesh,
+        self.mesh = (make_mesh(config.mesh, devices,
                                allow_subset=config.allow_device_subset)
                      if config.mesh
-                     else make_mesh(MeshConfig(), [jax.devices()[0]]))
+                     else make_mesh(MeshConfig(),
+                                    [(devices or jax.devices())[0]]))
         self.kv_manager = KVCacheManager(
             config.num_blocks, config.block_size,
             enable_prefix_caching=config.enable_prefix_caching)
@@ -129,6 +134,9 @@ class EngineCore:
             len(r.block_ids) for r in self.pinned_transfers.values())
         # Optional KV connector (set by the server / PD wiring).
         self.kv_connector = None
+        # Requests rejected before scheduling (e.g. kv_transfer_params with
+        # no connector); surfaced as outputs on the next step.
+        self._rejected: List[RequestOutput] = []
         self.eos_token_id: Optional[int] = None
         # Optional tokenizer enables engine-side stop-string detection (the
         # server sets it; without one, stop strings fall back to server-side
@@ -322,7 +330,33 @@ class EngineCore:
     # ---------- public API ----------
 
     def add_request(self, request: Request) -> None:
-        if self.kv_connector is not None and request.kv_transfer_params:
+        if request.do_remote_decode and (
+                self.kv_connector is None
+                or getattr(self.kv_connector, "server", None) is None):
+            # Producer contract needs a serving connector: without one the
+            # prefill would pin blocks forever (no release pump) or kill the
+            # engine loop in register_transfer's consumer-role assert.
+            logger.error(
+                "request %s asks for remote decode but this engine has no "
+                "producer-role KV connector; rejecting", request.request_id)
+            request.state = RequestState.FINISHED_ABORTED
+            self._rejected.append(RequestOutput(
+                request.request_id, [], True,
+                finish_reason=RequestState.FINISHED_ABORTED.value))
+            return
+        if request.kv_transfer_params:
+            if self.kv_connector is None:
+                # Silent local prefill here would defeat disaggregation while
+                # looking healthy; fail the request loudly instead
+                # (kv_load_failure_policy:"fail" doctrine, decode.yaml:96).
+                logger.error(
+                    "request %s carries kv_transfer_params but no KV "
+                    "connector is configured; rejecting", request.request_id)
+                request.state = RequestState.FINISHED_ABORTED
+                self._rejected.append(RequestOutput(
+                    request.request_id, [], True,
+                    finish_reason=RequestState.FINISHED_ABORTED.value))
+                return
             # PD consumer: pull remote KV before the request becomes schedulable.
             self.kv_connector.start_load_kv(self, request)
             return
@@ -335,9 +369,15 @@ class EngineCore:
         req = self.pinned_transfers.pop(request_id, None)
         if req is not None:
             self.kv_manager.free(req)
+        if self.kv_connector is not None:
+            # Consumer side: the request may only exist as an in-flight KV
+            # pull; mark it so poll() drops instead of admitting it.
+            self.kv_connector.abort(request_id)
 
     def has_work(self) -> bool:
-        return self.scheduler.has_work()
+        if self.scheduler.has_work() or self._rejected:
+            return True
+        return self.kv_connector is not None and self.kv_connector.has_pending()
 
     def release_pinned(self, request_id: str) -> None:
         """Producer side: transfer complete, free the pinned prefill blocks."""
@@ -418,6 +458,13 @@ class EngineCore:
 
     def step(self) -> List[RequestOutput]:
         outputs: List[RequestOutput] = []
+        if self._rejected:
+            outputs.extend(self._rejected)
+            self._rejected.clear()
+        if self.kv_connector is not None:
+            # Pump the connector: admit finished KV pulls, surface failed
+            # ones, release producer pins the consumer acknowledged.
+            outputs.extend(self.kv_connector.poll(self))
         sched = self.scheduler.schedule()
         for req in sched.preempted:      # oversized requests finished by scheduler
             outputs.append(RequestOutput(
@@ -490,6 +537,10 @@ class EngineCore:
         req.state = RequestState.FINISHED_REMOTE_PREFILL
         self.scheduler.running.remove(req)
         self.pinned_transfers[req.request_id] = req
+        if self.kv_connector is not None:
+            # Stage the pinned blocks' KV to host and serve them under the
+            # request uuid (consumer address comes from kv_transfer_params).
+            self.kv_connector.register_transfer(self, req)
         params: Dict[str, Any] = {
             "remote_block_ids": list(req.block_ids),
             "remote_host": getattr(self.kv_connector, "host", "localhost"),
@@ -549,4 +600,6 @@ class EngineCore:
             if not self.has_work():
                 break
             self.step()
+            if not self.scheduler.has_work() and self.has_work():
+                time.sleep(0.001)   # only async connector work pending
         return {r.request_id: list(r.output_token_ids) for r in requests}

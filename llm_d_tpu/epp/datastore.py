@@ -88,6 +88,9 @@ class Datastore:
     async def _scrape(self, e: EndpointState) -> None:
         try:
             async with self._session.get(f"{e.url}/metrics") as resp:
+                # A 5xx with a parseable-but-empty body would score as a
+                # zero-load (= most attractive) endpoint; only 200 is ready.
+                resp.raise_for_status()
                 text = await resp.text()
             m = parse_prometheus_text(text)
             e.num_waiting = m.get("vllm:num_requests_waiting", 0.0)

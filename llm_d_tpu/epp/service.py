@@ -119,24 +119,26 @@ class Gateway:
                        if k != DESTINATION_HEADER}
         url = f"{primary.url}{request.path}"
         try:
-            upstream = await self._session.post(
-                url, json=body, headers=fwd_headers,
-                timeout=aiohttp.ClientTimeout(total=600))
-        except Exception as exc:
+            # No total timeout: it would count SSE streaming time and sever
+            # long generations mid-stream; connect failures surface fast.
+            async with self._session.post(
+                    url, json=body, headers=fwd_headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=None, sock_connect=10)) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k in ("Content-Type",):
+                    if k in upstream.headers:
+                        resp.headers[k] = upstream.headers[k]
+                resp.headers[DESTINATION_HEADER] = primary.address
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except aiohttp.ClientError as exc:
             return web.json_response(
                 {"error": f"upstream {primary.address} failed: {exc}"},
                 status=502)
-
-        resp = web.StreamResponse(status=upstream.status)
-        for k in ("Content-Type",):
-            if k in upstream.headers:
-                resp.headers[k] = upstream.headers[k]
-        resp.headers[DESTINATION_HEADER] = primary.address
-        await resp.prepare(request)
-        async for chunk in upstream.content.iter_any():
-            await resp.write(chunk)
-        await resp.write_eof()
-        return resp
 
     def _make_ctx(self, body: Dict, request: web.Request) -> RequestCtx:
         prompt = body.get("prompt")

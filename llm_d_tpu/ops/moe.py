@@ -10,18 +10,29 @@ wide-ep decode.yaml:76-132).  Design:
     ``jax.lax.ragged_dot`` — one MXU-friendly kernel over all local experts
     instead of a Python loop (the DeepGEMM role).
   - Expert parallelism: experts shard over the *flattened* (dp, sp, tp) mesh
-    axes ("TPxDP in attention, EP in MoE layers", decode.yaml:76,87).  Each
-    shard computes its local experts for every token (tokens are replicated
-    in the serving engine) and contributions combine with one ``psum`` over
-    ICI — the all-to-all dispatch/combine collapses into zero-padded
-    scatter-add + psum, which XLA schedules over ICI without NVSHMEM-style
-    bootstrap.  A ragged-all-to-all dispatch path is the planned upgrade for
-    DP-sharded activations (tracked with the DBO work).
+    axes ("TPxDP in attention, EP in MoE layers", decode.yaml:76,87).  Two
+    dispatch strategies:
+
+      * ``a2a`` (default multi-device): the DeepEP role.  Tokens are split
+        over the EP shards; each (token, choice) row travels ONLY to the
+        shard owning its expert via ``jax.lax.ragged_all_to_all`` over ICI,
+        the grouped GEMM runs on received rows, and results return by the
+        reverse exchange — no full-activation all-reduce per MoE layer.
+        Dispatch is chunked (``LLMD_MOE_DP_CHUNK_SIZE``, the
+        ``VLLM_MOE_DP_CHUNK_SIZE`` analogue, decode.yaml:108-118) to bound
+        the exchange buffers.  XLA:CPU has no ragged-all-to-all, so tests
+        run the same fixed-region layout through a dense ``all_to_all``
+        (identical math, padded comm volume).
+
+      * ``psum`` (oracle / fallback): each shard computes all T tokens
+        against its local experts and partial outputs all-reduce.  Kept as
+        the correctness oracle and for shapes the a2a path can't split.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -137,6 +148,159 @@ def _local_expert_ffn(
     return out
 
 
+def _excl_cumsum(v: jax.Array) -> jax.Array:
+    return jnp.concatenate([jnp.zeros(1, v.dtype), jnp.cumsum(v)[:-1]])
+
+
+def _a2a_moe_chunk(
+    x_c: jax.Array,        # [Tc, H] this shard's token chunk
+    w_c: jax.Array,        # [Tc, k]
+    idx_c: jax.Array,      # [Tc, k] global (physical) expert ids
+    w_gate: jax.Array,     # [E_loc, H, I] local expert slice
+    w_up: jax.Array,
+    w_down: jax.Array,
+    ep: int,
+    my_rank: jax.Array,
+    ragged: bool,
+) -> jax.Array:            # [Tc, H] f32
+    """One chunk of the sparse dispatch/compute/combine pipeline.
+
+    Wire layout (both exchange primitives share it): the receive buffer has
+    a fixed region of ``S = Tc*k`` rows per source shard; source ``s``'s
+    rows land contiguously from offset ``s*S``.  ``ragged`` sends only the
+    actual row counts (TPU, dynamic comm volume); the dense emulation ships
+    the padded regions (CPU tests, identical math).
+    """
+    Tc, H = x_c.shape
+    k = idx_c.shape[1]
+    E_loc = w_gate.shape[0]
+    S = Tc * k
+
+    flat = idx_c.reshape(S)
+    dest = (flat // E_loc).astype(jnp.int32)
+    order = jnp.argsort(dest, stable=True)          # send order: by dest shard
+    dest_s = dest[order]
+    eloc_s = (flat % E_loc)[order].astype(jnp.int32)
+    tok_s = order // k
+
+    send_counts = jnp.zeros(ep, jnp.int32).at[dest].add(1)
+    input_offsets = _excl_cumsum(send_counts)
+    all_counts = jax.lax.all_gather(
+        send_counts, AXIS_EP, tiled=False)          # [ep_src, ep_dst]
+    recv_sizes = all_counts[:, my_rank]
+
+    payload = x_c[tok_s]                            # [S, H]
+    if ragged:
+        output_offsets = (my_rank * S) * jnp.ones(ep, jnp.int32)
+        recv_x = jax.lax.ragged_all_to_all(
+            payload, jnp.zeros((ep * S, H), payload.dtype),
+            input_offsets, send_counts, output_offsets, recv_sizes,
+            axis_name=AXIS_EP)
+        recv_e = jax.lax.ragged_all_to_all(
+            eloc_s, jnp.zeros(ep * S, jnp.int32),
+            input_offsets, send_counts, output_offsets, recv_sizes,
+            axis_name=AXIS_EP)
+    else:
+        within = jnp.arange(S, dtype=jnp.int32) - input_offsets[dest_s]
+        pidx = dest_s * S + within
+        recv_x = jax.lax.all_to_all(
+            jnp.zeros((ep * S, H), payload.dtype).at[pidx].set(payload),
+            AXIS_EP, split_axis=0, concat_axis=0, tiled=True)
+        recv_e = jax.lax.all_to_all(
+            jnp.zeros(ep * S, jnp.int32).at[pidx].set(eloc_s),
+            AXIS_EP, split_axis=0, concat_axis=0, tiled=True)
+
+    # Grouped GEMM over received rows (invalid region tails -> trash group).
+    rows = ep * S
+    region = jnp.arange(rows, dtype=jnp.int32) // S
+    valid = (jnp.arange(rows, dtype=jnp.int32) % S) < recv_sizes[region]
+    e_key = jnp.where(valid, recv_e, E_loc)
+    order2 = jnp.argsort(e_key, stable=True)
+    xs = recv_x[order2]
+    counts_e = jnp.zeros(E_loc, jnp.int32).at[
+        jnp.where(valid, recv_e, 0)].add(valid.astype(jnp.int32))
+    group_sizes = jnp.concatenate([counts_e, (rows - counts_e.sum())[None]])
+    zg = jnp.zeros((1,) + w_gate.shape[1:], w_gate.dtype)
+    zd = jnp.zeros((1,) + w_down.shape[1:], w_down.dtype)
+    y = _swiglu_grouped(
+        xs, jnp.concatenate([w_gate, zg]), jnp.concatenate([w_up, zg]),
+        jnp.concatenate([w_down, zd]), group_sizes)          # [rows, H] f32
+    y = jnp.zeros((rows, H), jnp.float32).at[order2].set(y)  # arrival order
+
+    # Combine: results travel back by the exact reverse exchange; weights
+    # are applied at the origin (they never cross the wire).
+    if ragged:
+        # On this shard, rows to return to shard d sit at region d (d*S);
+        # they must land at d's original send offsets toward us.
+        excl_dst = jnp.cumsum(all_counts, axis=1) - all_counts
+        ret = jax.lax.ragged_all_to_all(
+            y, jnp.zeros((S, H), jnp.float32),
+            jnp.arange(ep, dtype=jnp.int32) * S, recv_sizes,
+            excl_dst[:, my_rank], send_counts,
+            axis_name=AXIS_EP)                               # [S, H]
+    else:
+        ret_pad = jax.lax.all_to_all(
+            y, AXIS_EP, split_axis=0, concat_axis=0, tiled=True)
+        ret = ret_pad[pidx]                                  # [S, H]
+
+    contrib = ret * w_c.reshape(S)[order][:, None]
+    return jnp.zeros((Tc, H), jnp.float32).at[tok_s].add(contrib)
+
+
+def expert_ffn_a2a(
+    x: jax.Array, weights: jax.Array, idx: jax.Array,
+    w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array,
+    mesh: Mesh,
+    chunk_tokens: Optional[int] = None,
+) -> jax.Array:
+    """Sparse all-to-all EP dispatch (the DeepEP role; see module docstring).
+
+    Tokens split over the EP shards (in_specs slice the replicated batch);
+    each (token, choice) row visits only its expert's shard.  Requires
+    ``T % ep == 0`` and ``E % ep == 0`` — callers fall back to ``psum``
+    otherwise.
+    """
+    ep = mesh.devices.size
+    E = w_gate.shape[0]
+    T = x.shape[0]
+    assert T % ep == 0 and E % ep == 0
+    T_loc = T // ep
+    if chunk_tokens is None:
+        chunk_tokens = int(os.environ.get("LLMD_MOE_DP_CHUNK_SIZE", "1024"))
+    chunk_tokens = max(1, min(chunk_tokens, T_loc))
+    while T_loc % chunk_tokens:
+        chunk_tokens -= 1
+    n_chunks = T_loc // chunk_tokens
+    ragged = jax.default_backend() == "tpu"
+    sizes = [mesh.shape[a] for a in AXIS_EP]
+
+    def shard_body(x, weights, idx, w_gate, w_up, w_down):
+        ep_rank = jnp.int32(0)
+        for a, s in zip(AXIS_EP, sizes):
+            ep_rank = ep_rank * s + jax.lax.axis_index(a)
+        outs = []
+        for ci in range(n_chunks):
+            sl = slice(ci * chunk_tokens, (ci + 1) * chunk_tokens)
+            outs.append(_a2a_moe_chunk(
+                x[sl], weights[sl], idx[sl], w_gate, w_up, w_down,
+                ep, ep_rank, ragged))
+        out = jnp.concatenate(outs) if n_chunks > 1 else outs[0]
+        # Every shard needs the full hidden state back (attention and the
+        # residual stream are replicated in-engine): one bf16 all-gather —
+        # half the bytes of the f32 psum combine, and the dispatch above
+        # moved only routed rows instead of everything.
+        return jax.lax.all_gather(
+            out.astype(x.dtype), AXIS_EP, axis=0, tiled=True)
+
+    return jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(AXIS_EP), P(AXIS_EP), P(AXIS_EP),
+                  P(AXIS_EP), P(AXIS_EP), P(AXIS_EP)),
+        out_specs=P(),
+        check_vma=False,
+    )(x, weights, idx, w_gate, w_up, w_down)
+
+
 def expert_ffn(
     x: jax.Array,          # [T, H]
     weights: jax.Array,    # [T, k]
@@ -145,12 +309,13 @@ def expert_ffn(
     w_up: jax.Array,
     w_down: jax.Array,     # [E, I, H]
     mesh: Optional[Mesh] = None,
+    dispatch: str = "auto",   # auto | a2a | psum
 ) -> jax.Array:            # [T, H] in x.dtype
     """Routed-expert FFN, expert-parallel over the flattened mesh.
 
-    Single-device: one grouped GEMM over all experts.  Multi-device: each EP
-    shard runs the grouped GEMM for its expert slice and partial outputs
-    psum over ICI (see module docstring for the dispatch design).
+    Single-device: one grouped GEMM over all experts.  Multi-device:
+    sparse all-to-all dispatch by default (``LLMD_MOE_DISPATCH=psum``
+    forces the oracle path; see module docstring).
     """
     if mesh is None or mesh.devices.size == 1:
         out = _local_expert_ffn(
@@ -160,6 +325,12 @@ def expert_ffn(
     E = w_gate.shape[0]
     ep = mesh.devices.size
     E_loc = E // ep
+    if dispatch == "auto":
+        dispatch = os.environ.get("LLMD_MOE_DISPATCH", "auto")
+    if dispatch == "auto":
+        dispatch = "a2a" if (x.shape[0] % ep == 0 and E % ep == 0) else "psum"
+    if dispatch == "a2a":
+        return expert_ffn_a2a(x, weights, idx, w_gate, w_up, w_down, mesh)
 
     sizes = [mesh.shape[a] for a in AXIS_EP]
 

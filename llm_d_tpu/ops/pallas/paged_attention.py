@@ -169,7 +169,8 @@ def _decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "num_kv_heads", "scale", "soft_cap"))
+    jax.jit, static_argnames=("block_size", "num_kv_heads", "scale", "soft_cap",
+                              "interpret"))
 def paged_attention_decode_update(
     q: jax.Array,             # [S, H, D]
     k_new: jax.Array,         # [S, F] new K rows (one per sequence)
@@ -183,6 +184,7 @@ def paged_attention_decode_update(
     scale: float | None = None,
     soft_cap: float | None = None,
     layer: jax.Array | None = None,   # i32 scalar; None -> 2D caches
+    interpret: bool = False,  # CPU emulation for kernel parity tests
 ):
     """Returns (attn_out [S, H, D], k_cache', v_cache').
 
@@ -243,6 +245,7 @@ def paged_attention_decode_update(
         input_output_aliases={6: 1, 7: 2},
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",), has_side_effects=True),
+        interpret=interpret,
     )(block_tables, seq_lens, layer_arr, q,
       k_new.reshape(S, 1, F), v_new.reshape(S, 1, F), k_cache, v_cache)
     if squeeze:

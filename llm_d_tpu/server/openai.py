@@ -285,6 +285,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="permit a mesh smaller than the host's device count "
              "(deliberately idle chips); default is to fail fast")
     p.add_argument(
+        "--kv-transfer-config", default=None,
+        help="JSON KV-connector config for PD disaggregation, e.g. "
+             '\'{"kv_connector":"TPUConnector","kv_role":"kv_producer",'
+             '"kv_ip":"10.0.0.5","kv_port":5557}\' (reference: '
+             "ms-pd/values_tpu.yaml:44,131)")
+    p.add_argument(
         "--kv-events-endpoint", default=None,
         help="ZMQ endpoint of the EPP's KV-event sink (e.g. "
              "tcp://epp-host:5557); enables precise prefix routing "
@@ -300,11 +306,28 @@ def main(argv: Optional[List[str]] = None) -> None:
         model=args.model, block_size=args.block_size,
         num_blocks=args.num_blocks, max_num_seqs=args.max_num_seqs,
         max_num_batched_tokens=args.max_num_batched_tokens,
-        mesh=MeshConfig(dp=args.data_parallel_size,
-                        tp=args.tensor_parallel_size)
-        if args.tensor_parallel_size * args.data_parallel_size > 1 else None,
+        mesh=MeshConfig(tp=args.tensor_parallel_size)
+        if args.tensor_parallel_size > 1 else None,
         allow_device_subset=args.allow_device_subset)
-    server = build_server(cfg, args.tokenizer)
+    engine = None
+    if args.data_parallel_size > 1:
+        # DP = per-rank engine cores over disjoint tp-submeshes behind a
+        # local least-loaded dispatcher (reference: decode.yaml:73-93).
+        from llm_d_tpu.engine.dp_group import DPEngineGroup
+        engine = DPEngineGroup(cfg, dp_size=args.data_parallel_size)
+    server = build_server(cfg, args.tokenizer, engine=engine)
+    if args.kv_transfer_config:
+        from llm_d_tpu.transfer import KVConnectorConfig, TpuConnector
+        ktc = json.loads(args.kv_transfer_config)
+        conn_cfg = KVConnectorConfig(
+            kv_role=ktc.get("kv_role", "kv_both"),
+            host=ktc.get("kv_ip", "127.0.0.1"),
+            port=int(ktc.get("kv_port", 0)),
+            kv_load_failure_policy=ktc.get("kv_load_failure_policy", "fail"))
+        server.engine.kv_connector = TpuConnector(conn_cfg)
+        logger.info("KV connector: role=%s serving on %s:%s",
+                    conn_cfg.kv_role, conn_cfg.host,
+                    server.engine.kv_connector.port)
     if args.kv_events_endpoint:
         from llm_d_tpu.events.kv_events import ZmqKvEventPublisher
         identity = args.pod_identity
@@ -322,7 +345,11 @@ def main(argv: Optional[List[str]] = None) -> None:
             identity = f"{host}:{args.port}"
         publisher = ZmqKvEventPublisher(
             args.kv_events_endpoint, identity, model=args.model)
-        publisher.attach(server.engine.kv_manager)
+        # A DP group caches blocks in every rank's manager; the precise
+        # prefix index must see all of them, not just rank 0's.
+        for km in getattr(server.engine, "kv_managers",
+                          [server.engine.kv_manager]):
+            publisher.attach(km)
         publisher.start()
         server.kv_event_publisher = publisher
     logging.basicConfig(level=logging.INFO)

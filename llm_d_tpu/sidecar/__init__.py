@@ -1,0 +1,1 @@
+from llm_d_tpu.sidecar.proxy import RoutingSidecar, main  # noqa: F401

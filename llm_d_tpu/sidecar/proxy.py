@@ -1,0 +1,169 @@
+"""Routing sidecar: per-decode-pod proxy executing P->D orchestration.
+
+The reference runs ``llm-d-routing-sidecar`` in front of every decode vLLM
+(:8000 proxying :8200) with ``--connector=nixlv2``; for each request it
+first issues the prefill to the pod the EPP chose (the
+``x-prefiller-host-port`` hint header), then forwards the original request
+to the local engine with the returned ``kv_transfer_params`` so its
+connector pulls the KV (reference: wide-ep decode.yaml:23-29, SURVEY §3.3).
+
+This is that proxy for the TPU stack: same ports, same hint header, same
+two-step orchestration, with the ``TpuConnector`` transfer underneath.
+``--prefiller`` pins a static prefill target for setups without an EPP.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+PREFILLER_HEADER = "x-prefiller-host-port"
+
+# Hop-by-hop headers a proxy must not forward verbatim.
+_HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
+                "keep-alive", "te", "upgrade"}
+
+
+class RoutingSidecar:
+    def __init__(self, decode_url: str,
+                 static_prefiller: Optional[str] = None,
+                 prefiller_use_tls: bool = False,
+                 prefill_timeout_s: float = 600.0) -> None:
+        self.decode_url = decode_url.rstrip("/")
+        self.static_prefiller = static_prefiller
+        self.scheme = "https" if prefiller_use_tls else "http"
+        self.prefill_timeout_s = prefill_timeout_s
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    # ---------- app ----------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/chat/completions", self.completions)
+        # Everything else (probes, /metrics, /v1/models, /tokenize) passes
+        # straight through to the local engine.
+        app.router.add_route("*", "/{tail:.*}", self.passthrough)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._session = aiohttp.ClientSession()
+
+    async def _on_cleanup(self, app) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+    # ---------- handlers ----------
+
+    async def passthrough(self, request: web.Request) -> web.StreamResponse:
+        url = f"{self.decode_url}/{request.match_info['tail']}"
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        body = await request.read()
+        async with self._session.request(
+                request.method, url, headers=headers,
+                data=body if body else None,
+                params=request.rel_url.query) as upstream:
+            return await self._relay(request, upstream)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid json"}, status=400)
+
+        prefiller = request.headers.get(PREFILLER_HEADER) \
+            or self.static_prefiller
+        if prefiller and not body.get("kv_transfer_params"):
+            try:
+                body = await self._run_prefill(request.path, body, prefiller)
+            except PrefillError as e:
+                logger.error("prefill via %s failed: %s", prefiller, e)
+                return web.json_response(
+                    {"error": f"prefill failed: {e}"}, status=502)
+
+        async with self._session.post(
+                f"{self.decode_url}{request.path}", json=body) as upstream:
+            return await self._relay(request, upstream)
+
+    async def _run_prefill(self, path: str, body: dict, prefiller: str) -> dict:
+        """Step 1 of the PD contract: remote prefill, returns the decode body.
+
+        The prefill request mirrors the original but generates a single
+        token under ``do_remote_decode`` — the producer stops after prefill,
+        pins KV, and answers with ``kv_transfer_params`` which we attach for
+        the local decode engine's connector pull
+        (reference: README.tpu.md:182-189).
+        """
+        prefill_body = dict(body)
+        prefill_body["stream"] = False
+        prefill_body["max_tokens"] = 1
+        prefill_body["kv_transfer_params"] = {"do_remote_decode": True}
+        url = f"{self.scheme}://{prefiller}{path}"
+        try:
+            async with self._session.post(
+                    url, json=prefill_body,
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.prefill_timeout_s)) as resp:
+                if resp.status != 200:
+                    raise PrefillError(f"HTTP {resp.status}")
+                payload = await resp.json()
+        except aiohttp.ClientError as e:
+            raise PrefillError(str(e)) from e
+        params = payload.get("kv_transfer_params")
+        if not params:
+            raise PrefillError("prefill response missing kv_transfer_params")
+        decode_body = dict(body)
+        decode_body["kv_transfer_params"] = params
+        return decode_body
+
+    async def _relay(self, request: web.Request,
+                     upstream: aiohttp.ClientResponse) -> web.StreamResponse:
+        """Stream the upstream response back (SSE-safe chunked relay)."""
+        resp = web.StreamResponse(status=upstream.status)
+        for k, v in upstream.headers.items():
+            if k.lower() not in _HOP_HEADERS:
+                resp.headers[k] = v
+        await resp.prepare(request)
+        async for chunk in upstream.content.iter_any():
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
+
+class PrefillError(Exception):
+    pass
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("llmd-sidecar")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000,
+                   help="listen port (the address the EPP routes to)")
+    p.add_argument("--decode-url", default="http://127.0.0.1:8200",
+                   help="local decode engine (vLLM-equivalent) base URL")
+    p.add_argument("--prefiller", default=None,
+                   help="static prefill host:port when no EPP hint header "
+                        "is present")
+    p.add_argument("--connector", default="tpu",
+                   help="accepted for reference-flag compatibility "
+                        "(--connector=nixlv2 analogue); only 'tpu' exists")
+    p.add_argument("--prefiller-use-tls", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    sidecar = RoutingSidecar(args.decode_url, args.prefiller,
+                             prefiller_use_tls=args.prefiller_use_tls)
+    web.run_app(sidecar.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from llm_d_tpu.transfer.connector import (  # noqa: F401
+    KVConnectorConfig,
+    TpuConnector,
+)
+from llm_d_tpu.transfer.transport import (  # noqa: F401
+    TransferError,
+    TransferNotFound,
+)

@@ -1,0 +1,311 @@
+"""TPU KV connector: P->D disaggregation's engine-side halves.
+
+Mirrors the reference's vLLM KV-connector contract
+(``--kv-transfer-config '{"kv_connector":"TPUConnector","kv_role":...}'``,
+ms-pd/values_tpu.yaml:44,131; response params README.tpu.md:182-189):
+
+  producer ("kv_producer"/"kv_both"): after a ``do_remote_decode`` prefill
+    the engine pins the request's blocks; the connector gathers their KV
+    (one jitted device gather + a single device_get) and registers the host
+    slab with the native transfer server under the request uuid.  The
+    response's ``kv_transfer_params`` advertises {remote_block_ids,
+    remote_host, remote_port, uuid}.
+
+  consumer ("kv_consumer"/"kv_both"): a request arriving with
+    ``kv_transfer_params`` is diverted before scheduling; a worker thread
+    fetches the slab, then the engine thread allocates local blocks,
+    scatters the KV in (one jitted update), marks all but the last prompt
+    token computed, and enqueues the request — only the final prompt token
+    is recomputed locally to produce sampling logits.
+
+``kv_load_failure_policy`` follows decode.yaml:96: "fail" aborts the request
+loudly; "recompute" falls back to a full local prefill.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import queue
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_d_tpu.engine.request import Request, RequestOutput, RequestState
+from llm_d_tpu.transfer import transport
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = 0x4B565442  # "KVTB"
+_HEADER = struct.Struct("<IIIII")  # magic, num_layers, block_size, F, nb
+
+
+def _next_pow2(n: int, lo: int = 1) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class KVConnectorConfig:
+    kv_role: str = "kv_both"            # kv_producer | kv_consumer | kv_both
+    host: str = "127.0.0.1"             # address advertised to consumers
+    port: int = 0                        # 0 = ephemeral
+    kv_load_failure_policy: str = "fail"  # fail | recompute
+    timeout_ms: int = 30000
+    # Producer-side safety valve: pinned blocks whose consumer never pulled
+    # are released after this long (the reference leans on request timeouts;
+    # an engine must not leak cache to a dead peer).
+    pin_timeout_s: float = 120.0
+
+
+class TpuConnector:
+    """Both halves of the P->D transfer, bound to one EngineCore."""
+
+    def __init__(self, config: KVConnectorConfig) -> None:
+        self.config = config
+        self.host = config.host
+        self.server = None
+        self.port = 0
+        if config.kv_role in ("kv_producer", "kv_both"):
+            self.server = transport.make_server("0.0.0.0", config.port)
+            self.port = self.server.port
+        # consumer side: fetches finished by worker threads, drained by the
+        # engine thread in poll().
+        self._loaded: "queue.Queue[Tuple[Request, Optional[bytes], Optional[str], float]]" = (
+            queue.Queue())
+        self._inflight = 0
+        self._inflight_mu = threading.Lock()
+        self._retry: List[Tuple[Request, bytes]] = []
+        self._pin_times: Dict[str, float] = {}
+        # Requests aborted while their KV pull was in flight: dropped at
+        # poll() instead of being admitted for a disconnected client.
+        self._aborted: set = set()
+
+    # ------------------------------------------------------------------
+    # producer side
+    # ------------------------------------------------------------------
+
+    def register_transfer(self, engine, req: Request) -> None:
+        """Gather the pinned blocks' KV to host and serve them under the uuid."""
+        assert self.server is not None, \
+            "register_transfer on a consumer-only connector"
+        blob = _pack_blocks(engine, req.block_ids)
+        self.server.register(req.request_id, blob)
+        self._pin_times[req.request_id] = time.monotonic()
+
+    def _poll_producer(self, engine) -> None:
+        if self.server is None:
+            return
+        for uuid in self.server.drain_released():
+            self._pin_times.pop(uuid, None)
+            engine.release_pinned(uuid)
+        if self._pin_times:
+            now = time.monotonic()
+            expired = [u for u, t in self._pin_times.items()
+                       if now - t > self.config.pin_timeout_s]
+            for uuid in expired:
+                logger.warning("pinned transfer %s expired; releasing", uuid)
+                self._pin_times.pop(uuid, None)
+                self.server.unregister(uuid)
+                engine.release_pinned(uuid)
+
+    # ------------------------------------------------------------------
+    # consumer side
+    # ------------------------------------------------------------------
+
+    def start_load_kv(self, engine, req: Request) -> None:
+        """Begin the remote pull; the request joins the scheduler via poll()."""
+        params = req.kv_transfer_params or {}
+        with self._inflight_mu:
+            self._inflight += 1
+        threading.Thread(
+            target=self._fetch_worker, args=(req, params),
+            name=f"kv-pull-{req.request_id[:8]}", daemon=True).start()
+
+    def _fetch_worker(self, req: Request, params: Dict[str, Any]) -> None:
+        t0 = time.perf_counter()
+        blob: Optional[bytes] = None
+        error: Optional[str] = None
+        try:
+            host = params["remote_host"]
+            port = int(params["remote_port"])
+            uuid = params.get("uuid", req.request_id)
+            blob = transport.fetch(host, port, uuid,
+                                   timeout_ms=self.config.timeout_ms)
+            # The slab is on this host now; free the producer immediately
+            # (its pinned prefill blocks return to the pool).
+            transport.release(host, port, uuid,
+                              timeout_ms=self.config.timeout_ms)
+        except (transport.TransferError, KeyError, OSError, ValueError) as e:
+            error = f"{type(e).__name__}: {e}"
+        self._loaded.put((req, blob, error, time.perf_counter() - t0))
+
+    def abort(self, request_id: str) -> None:
+        """Mark an in-flight pull's request aborted (dropped at poll)."""
+        self._aborted.add(request_id)
+
+    def has_pending(self) -> bool:
+        with self._inflight_mu:
+            if self._inflight > 0:
+                return True
+        return bool(self._retry) or bool(self._pin_times)
+
+    def poll(self, engine) -> List[RequestOutput]:
+        """Engine-thread pump: finish loads, admit requests, drain releases."""
+        self._poll_producer(engine)
+        outputs: List[RequestOutput] = []
+
+        ready: List[Tuple[Request, bytes]] = list(self._retry)
+        self._retry.clear()
+        while True:
+            try:
+                req, blob, error, dt = self._loaded.get_nowait()
+            except queue.Empty:
+                break
+            with self._inflight_mu:
+                self._inflight -= 1
+            if error is not None or blob is None:
+                outputs.extend(self._load_failed(engine, req, error or "empty"))
+                continue
+            engine.metrics.kv_transfer_time.observe(dt)
+            ready.append((req, blob))
+        if self._aborted:
+            dropped = [r for r, _ in ready if r.request_id in self._aborted]
+            for r in dropped:
+                r.state = RequestState.FINISHED_ABORTED
+                self._aborted.discard(r.request_id)
+            ready = [(r, b) for r, b in ready
+                     if r.state is not RequestState.FINISHED_ABORTED]
+
+        for req, blob in ready:
+            out = self._admit(engine, req, blob)
+            if out is not None:
+                outputs.append(out)
+        return outputs
+
+    def _admit(self, engine, req: Request, blob: bytes) -> Optional[RequestOutput]:
+        """Scatter the fetched KV into local blocks and make req schedulable."""
+        P = req.num_prompt_tokens
+        bs = engine.config.block_size
+        nb = -(-P // bs)
+        if not engine.kv_manager.can_allocate(nb):
+            # Cache pressure: hold the slab and retry next poll (the blocks
+            # will free as running requests finish).
+            self._retry.append((req, blob))
+            return None
+        attached = engine.kv_manager.allocate(req, P)
+        if attached is None:
+            self._retry.append((req, blob))
+            return None
+        try:
+            _scatter_blocks(engine, req.block_ids, blob)
+        except ValueError as e:
+            engine.kv_manager.free(req)
+            return_list = self._load_failed(engine, req, f"bad slab: {e}")
+            return return_list[0] if return_list else None
+        req.num_computed_tokens = P - 1   # last prompt token recomputed locally
+        req.kv_transfer_params = None
+        engine.scheduler.add_request(req)
+        return None
+
+    def _load_failed(self, engine, req: Request, error: str
+                     ) -> List[RequestOutput]:
+        if self.config.kv_load_failure_policy == "recompute":
+            logger.warning("kv load failed for %s (%s); recomputing locally",
+                           req.request_id, error)
+            req.do_remote_prefill = False
+            req.kv_transfer_params = None
+            engine.scheduler.add_request(req)
+            return []
+        logger.error("kv load failed for %s: %s", req.request_id, error)
+        req.state = RequestState.FINISHED_ABORTED
+        return [RequestOutput(req.request_id, [], True,
+                              finish_reason=RequestState.FINISHED_ABORTED.value)]
+
+    def close(self) -> None:
+        if self.server is not None:
+            self.server.close()
+
+
+# ---------------------------------------------------------------------------
+# Device <-> host slab marshalling.  One jitted program per (padded) block
+# count: gather/scatter the [L, slots, F] stacked cache at whole-block
+# granularity, staged through a single contiguous [2, L, nb*bs, F] buffer.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _gather_fn(num_blocks: int, block_size: int):
+    @jax.jit
+    def gather(k, v, block_ids):
+        # block_ids: [nb] int32 (padded entries point at the null block 0).
+        slots = (block_ids[:, None] * block_size
+                 + jnp.arange(block_size, dtype=jnp.int32)[None, :]).reshape(-1)
+        return jnp.stack([k[:, slots, :], v[:, slots, :]])  # [2, L, nb*bs, F]
+    return gather
+
+
+@functools.lru_cache(maxsize=32)
+def _scatter_fn(num_blocks: int, block_size: int):
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def scatter(k, v, block_ids, slab):
+        slots = (block_ids[:, None] * block_size
+                 + jnp.arange(block_size, dtype=jnp.int32)[None, :]).reshape(-1)
+        return (k.at[:, slots, :].set(slab[0]),
+                v.at[:, slots, :].set(slab[1]))
+    return scatter
+
+
+def _pack_blocks(engine, block_ids: List[int]) -> bytes:
+    k, v = engine.kv_cache["k"], engine.kv_cache["v"]
+    L, _, F = k.shape
+    bs = engine.config.block_size
+    nb = len(block_ids)
+    nb_pad = _next_pow2(max(nb, 1))
+    ids = np.zeros(nb_pad, np.int32)   # pad gathers the null block; trimmed below
+    ids[:nb] = block_ids
+    slab = _gather_fn(nb_pad, bs)(k, v, jnp.asarray(ids))
+    host = np.asarray(jax.device_get(slab))           # bf16 via ml_dtypes
+    host = host[:, :, :nb * bs, :]
+    header = _HEADER.pack(_MAGIC, L, bs, F, nb)
+    return header + host.tobytes()
+
+
+def _scatter_blocks(engine, block_ids: List[int], blob: bytes) -> None:
+    import ml_dtypes
+    k, v = engine.kv_cache["k"], engine.kv_cache["v"]
+    L, _, F = k.shape
+    bs = engine.config.block_size
+    magic, bL, bbs, bF, bnb = _HEADER.unpack_from(blob, 0)
+    if magic != _MAGIC:
+        raise ValueError("bad magic")
+    if (bL, bbs, bF) != (L, bs, F):
+        raise ValueError(
+            f"slab layout {(bL, bbs, bF)} != cache layout {(L, bs, F)}")
+    nb = len(block_ids)
+    if bnb < nb:
+        raise ValueError(f"slab has {bnb} blocks, need {nb}")
+    payload = np.frombuffer(blob, dtype=ml_dtypes.bfloat16,
+                            offset=_HEADER.size)
+    slab = payload.reshape(2, L, bnb * bs, F)[:, :, :nb * bs, :]
+    nb_pad = _next_pow2(max(nb, 1))
+    if nb_pad != nb:
+        # Padded scatter targets must be real, distinct slots: route the pad
+        # writes into the null block's slots (block 0 is the trash block).
+        pad_slab = np.zeros((2, L, nb_pad * bs, F), ml_dtypes.bfloat16)
+        pad_slab[:, :, :nb * bs, :] = slab
+        slab = pad_slab
+        ids = np.zeros(nb_pad, np.int32)
+        ids[:nb] = block_ids
+    else:
+        ids = np.asarray(block_ids, np.int32)
+    k_new, v_new = _scatter_fn(nb_pad, bs)(
+        k, v, jnp.asarray(ids), jnp.asarray(slab))
+    engine.kv_cache["k"], engine.kv_cache["v"] = k_new, v_new

@@ -1,0 +1,124 @@
+"""DP engine group: real data parallelism, not replicated compute.
+
+Parity: a dp=4 x tp=2 group over the 8-device CPU mesh must produce the
+same greedy tokens as a single engine.  Proof-of-sharding: each rank's KV
+cache and parameters live ONLY on that rank's 2 devices — a request's
+attention FLOPs and KV bytes touch 1/4 of the chips (the round-2 engine
+device_put everything replicated; reference DP semantics:
+decode.yaml:73-93 per-rank engine cores).
+"""
+
+import jax
+import pytest
+
+from llm_d_tpu.engine.dp_group import DPEngineGroup
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+from llm_d_tpu.engine.request import Request
+from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.parallel.mesh import MeshConfig
+
+ENGINE_KW = dict(model="tiny", block_size=4, num_blocks=64, max_num_seqs=8,
+                 max_num_batched_tokens=64, min_token_bucket=16,
+                 min_seq_bucket=4)
+
+
+def greedy_req(rid, prompt, n=6):
+    return Request(request_id=rid, prompt_token_ids=list(prompt),
+                   sampling=SamplingParams(temperature=0.0, max_tokens=n,
+                                           ignore_eos=True))
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    return EngineCore(EngineConfig(**ENGINE_KW))
+
+
+@pytest.fixture(scope="module")
+def group(baseline, devices):
+    host_params = jax.device_get(baseline.params)
+    return DPEngineGroup(
+        EngineConfig(**ENGINE_KW, mesh=MeshConfig(tp=2)),
+        dp_size=4, params=host_params)
+
+
+PROMPTS = {
+    "r1": [2, 4, 6, 8, 10],
+    "r2": [100, 90, 80, 70, 60, 50],
+    "r3": [7, 14, 21],
+    "r4": [11, 13, 17, 19, 23, 29, 31],
+    "r5": [1, 2, 3, 4],
+    "r6": [42],
+    "r7": [5, 10, 15, 20, 25, 30, 35, 40],
+    "r8": [99, 98, 97],
+}
+
+
+def test_group_matches_single_engine(baseline, group):
+    expected = {}
+    for rid, p in PROMPTS.items():
+        e = EngineCore(EngineConfig(**ENGINE_KW), params=baseline.params)
+        expected[rid] = e.generate([greedy_req(rid, p)])[rid]
+    out = group.generate([greedy_req(rid, p) for rid, p in PROMPTS.items()])
+    assert out == expected
+
+
+def test_ranks_own_disjoint_devices(group, devices):
+    """The sharding proof: per-rank KV/params touch only that rank's chips."""
+    assert len(group.engines) == 4
+    device_sets = []
+    for e in group.engines:
+        kv_devs = e.kv_cache["k"].sharding.device_set
+        assert len(kv_devs) == 2, "rank KV must live on its tp=2 submesh only"
+        # Params co-located with the KV cache on the same submesh.
+        embed_devs = jax.tree.leaves(e.params)[0].sharding.device_set
+        assert embed_devs == kv_devs
+        device_sets.append(kv_devs)
+    # Pairwise disjoint, union covers all 8 chips: no replicated compute.
+    union = set()
+    for ds in device_sets:
+        assert not (union & ds)
+        union |= ds
+    assert union == set(devices)
+
+
+def test_rank_kv_shard_shape(group):
+    """Per-device KV bytes: full slots per rank (its own pool), folded head
+    dim split over tp=2 — versus round 2 where every device held every
+    rank's cache."""
+    e = group.engines[0]
+    k = e.kv_cache["k"]
+    L, slots, F = k.shape
+    for shard in k.addressable_shards:
+        assert shard.data.shape == (L, slots, F // 2)
+
+
+def test_dispatch_balances_load(group):
+    reqs = [greedy_req(f"lb-{i}", [i + 1, i + 2, i + 3], 3) for i in range(8)]
+    for r in reqs:
+        group.add_request(r)
+    per_rank = [e.scheduler.num_waiting + e.scheduler.num_running
+                for e in group.engines]
+    assert per_rank == [2, 2, 2, 2]
+    while group.has_work():
+        group.step()
+    assert all(len(r.output_token_ids) == 3 for r in reqs)
+
+
+def test_abort_routes_to_owning_rank(group):
+    r = greedy_req("kill-me", [1, 2, 3], 50)
+    group.add_request(r)
+    group.step()
+    group.abort_request("kill-me")
+    assert all(rr.request_id != "kill-me"
+               for e in group.engines for rr in e.scheduler.running)
+
+
+def test_aggregated_gauges(group):
+    reqs = [greedy_req(f"g-{i}", [i + 1] * 3, 2) for i in range(4)]
+    for r in reqs:
+        group.add_request(r)
+    group.step()
+    text = group.metrics.render().decode()
+    assert "vllm:num_requests_running" in text
+    while group.has_work():
+        group.step()
