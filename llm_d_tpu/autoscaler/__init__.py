@@ -1,0 +1,7 @@
+from llm_d_tpu.autoscaler.wva import (  # noqa: F401
+    CapacityAnalyzer,
+    ModelBasedOptimizer,
+    VariantAutoscaler,
+    VariantAutoscalingSpec,
+    main,
+)

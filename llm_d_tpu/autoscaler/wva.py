@@ -1,0 +1,287 @@
+"""Workload Variant Autoscaler (WVA): saturation-based replica scaling.
+
+The reference runs workload-variant-autoscaler as a Collector -> Optimizer
+-> Actuator reconcile loop over Prometheus metrics, publishing the external
+metric ``inferno_desired_replicas`` that an HPA consumes with
+``targetAverageValue: 1`` (reference: guides/workload-autoscaling/README.md
+:145-151,294; values.yaml — reconcileInterval 60s, modes off/model-only/
+hybrid via ``experimentalHybridOptimization``, ``scaleToZero``, per-variant
+``sloTtft``/``sloTpot``).
+
+TPU translation, same three stages:
+
+  Collector  — scrapes each replica's ``/metrics`` directly (the vllm:*
+               load signals the EPP already consumes; no Prometheus-with-
+               TLS middleman needed for the in-process loop).
+  Optimizer  — capacity analyzer (reactive saturation: KV-cache
+               utilization + queue depth, exactly the two signals the
+               reference's saturation scaling documents) and a model-based
+               optimizer (throughput/SLO headroom from observed token
+               rates and latency histograms); "hybrid" arbitrates max().
+  Actuator   — publishes ``inferno_desired_replicas`` on /metrics for an
+               HPA/KEDA (or the driver loop in tests) to consume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import logging
+import math
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+from llm_d_tpu.utils.metrics import parse_prometheus_text
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class VariantAutoscalingSpec:
+    """The VariantAutoscaling CRD's knobs (reference: va.* values —
+    accelerator, sloTpot, sloTtft; hpa.maxReplicas; wva.scaleToZero)."""
+    model_id: str = "default"
+    accelerator: str = "v5e"
+    slo_ttft_ms: float = 1000.0
+    slo_tpot_ms: float = 10.0
+    min_replicas: int = 1
+    max_replicas: int = 10
+    scale_to_zero: bool = False
+    # Saturation the capacity analyzer steers each replica toward.
+    target_saturation: float = 0.6
+    mode: str = "capacity"          # capacity | model-only | hybrid
+
+
+@dataclasses.dataclass
+class ReplicaSample:
+    """One replica's scraped load signals."""
+    ready: bool = False
+    kv_usage: float = 0.0
+    num_waiting: float = 0.0
+    num_running: float = 0.0
+    generation_tokens_total: float = 0.0
+    ttft_sum: float = 0.0
+    ttft_count: float = 0.0
+    itl_sum: float = 0.0
+    itl_count: float = 0.0
+
+
+class Collector:
+    """Scrapes every replica's /metrics into ReplicaSamples."""
+
+    def __init__(self, endpoints: List[str]) -> None:
+        self.endpoints = endpoints
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=2.0))
+
+    async def stop(self) -> None:
+        if self._session:
+            await self._session.close()
+
+    async def collect(self) -> List[ReplicaSample]:
+        return list(await asyncio.gather(
+            *(self._scrape(ep) for ep in self.endpoints)))
+
+    async def _scrape(self, endpoint: str) -> ReplicaSample:
+        s = ReplicaSample()
+        try:
+            async with self._session.get(
+                    f"http://{endpoint}/metrics") as resp:
+                resp.raise_for_status()
+                m = parse_prometheus_text(await resp.text())
+        except Exception:
+            return s
+        s.ready = True
+        s.kv_usage = m.get("vllm:kv_cache_usage_perc", 0.0)
+        s.num_waiting = m.get("vllm:num_requests_waiting", 0.0)
+        s.num_running = m.get("vllm:num_requests_running", 0.0)
+        s.generation_tokens_total = m.get("vllm:generation_tokens_total", 0.0)
+        s.ttft_sum = m.get("vllm:time_to_first_token_seconds_sum", 0.0)
+        s.ttft_count = m.get("vllm:time_to_first_token_seconds_count", 0.0)
+        s.itl_sum = m.get("vllm:inter_token_latency_seconds_sum", 0.0)
+        s.itl_count = m.get("vllm:inter_token_latency_seconds_count", 0.0)
+        return s
+
+
+class CapacityAnalyzer:
+    """Reactive saturation scaling (the reference's default mode).
+
+    Saturation per replica = max(kv-cache utilization, queue pressure);
+    desired replicas move the mean saturation toward the target."""
+
+    def __init__(self, spec: VariantAutoscalingSpec,
+                 queue_norm: float = 8.0) -> None:
+        self.spec = spec
+        self.queue_norm = queue_norm    # waiting requests ~ "fully busy"
+
+    def desired(self, samples: List[ReplicaSample]) -> int:
+        spec = self.spec
+        up = [s for s in samples if s.ready]
+        current = max(len(up), 1)
+        if not up:
+            return max(spec.min_replicas, 1)
+        sat = [max(s.kv_usage, min(1.0, s.num_waiting / self.queue_norm))
+               for s in up]
+        mean_sat = sum(sat) / len(sat)
+        idle = all(s.num_waiting == 0 and s.num_running == 0 for s in up)
+        if idle and spec.scale_to_zero:
+            return 0
+        desired = math.ceil(current * mean_sat / spec.target_saturation) \
+            if mean_sat > 0 else spec.min_replicas
+        return max(spec.min_replicas, min(spec.max_replicas, desired))
+
+
+class ModelBasedOptimizer:
+    """SLO-headroom optimizer (the ``model-only`` experimental mode).
+
+    Estimates mean TTFT/TPOT from the latency histograms and scales so the
+    projected latencies sit inside the variant's SLOs: latency under load
+    is modeled as inversely proportional to free capacity (an M/M/c-style
+    saturation curve linearized around the operating point)."""
+
+    def __init__(self, spec: VariantAutoscalingSpec) -> None:
+        self.spec = spec
+
+    def desired(self, samples: List[ReplicaSample]) -> int:
+        spec = self.spec
+        up = [s for s in samples if s.ready]
+        if not up:
+            return max(spec.min_replicas, 1)
+        current = len(up)
+        ttft_ms = _mean_ms(sum(s.ttft_sum for s in up),
+                           sum(s.ttft_count for s in up))
+        tpot_ms = _mean_ms(sum(s.itl_sum for s in up),
+                           sum(s.itl_count for s in up))
+        ratios = []
+        if ttft_ms > 0:
+            ratios.append(ttft_ms / spec.slo_ttft_ms)
+        if tpot_ms > 0:
+            ratios.append(tpot_ms / spec.slo_tpot_ms)
+        worst = max(ratios) if ratios else 1.0
+        desired = math.ceil(current * worst) if worst > 1.0 else current
+        # SLO comfortably met and queues empty -> allow scale-down.
+        if worst <= 0.5 and all(s.num_waiting == 0 for s in up):
+            desired = max(current - 1,
+                          0 if self.spec.scale_to_zero else spec.min_replicas)
+        return max(spec.min_replicas if not spec.scale_to_zero else 0,
+                   min(spec.max_replicas, desired))
+
+
+def _mean_ms(total_s: float, count: float) -> float:
+    return (total_s / count) * 1000.0 if count > 0 else 0.0
+
+
+class VariantAutoscaler:
+    """The reconcile loop + actuator metric endpoint."""
+
+    def __init__(self, spec: VariantAutoscalingSpec, endpoints: List[str],
+                 reconcile_interval_s: float = 60.0) -> None:
+        self.spec = spec
+        self.collector = Collector(endpoints)
+        self.capacity = CapacityAnalyzer(spec)
+        self.model = ModelBasedOptimizer(spec)
+        self.reconcile_interval_s = reconcile_interval_s
+        self.registry = CollectorRegistry()
+        self._desired_gauge = Gauge(
+            "inferno_desired_replicas",
+            "Replicas the autoscaler wants (HPA external metric).",
+            ["variant_name", "accelerator"], registry=self.registry,
+        ).labels(variant_name=spec.model_id, accelerator=spec.accelerator)
+        self._current_gauge = Gauge(
+            "inferno_current_replicas", "Ready replicas observed.",
+            ["variant_name"], registry=self.registry,
+        ).labels(variant_name=spec.model_id)
+        self.desired_replicas = spec.min_replicas
+        self._task: Optional[asyncio.Task] = None
+
+    def decide(self, samples: List[ReplicaSample]) -> int:
+        mode = self.spec.mode
+        cap = self.capacity.desired(samples)
+        if mode == "capacity":
+            desired = cap
+        elif mode == "model-only":
+            desired = self.model.desired(samples)
+        else:                       # hybrid: arbitrate (take the max)
+            desired = max(cap, self.model.desired(samples))
+        return desired
+
+    async def reconcile_once(self) -> int:
+        samples = await self.collector.collect()
+        self.desired_replicas = self.decide(samples)
+        self._desired_gauge.set(self.desired_replicas)
+        self._current_gauge.set(sum(1 for s in samples if s.ready))
+        return self.desired_replicas
+
+    # ---------- service ----------
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.reconcile_once()
+            except Exception:
+                logger.exception("reconcile failed")
+            await asyncio.sleep(self.reconcile_interval_s)
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/health", self._health)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        await self.collector.start()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _on_cleanup(self, app) -> None:
+        if self._task:
+            self._task.cancel()
+        await self.collector.stop()
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=generate_latest(self.registry),
+                            content_type="text/plain")
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("llmd-wva")
+    p.add_argument("--endpoints", required=True,
+                   help="comma-separated replica host:port list")
+    p.add_argument("--model-id", default="default")
+    p.add_argument("--accelerator", default="v5e")
+    p.add_argument("--slo-ttft-ms", type=float, default=1000.0)
+    p.add_argument("--slo-tpot-ms", type=float, default=10.0)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=10)
+    p.add_argument("--scale-to-zero", action="store_true")
+    p.add_argument("--mode", default="capacity",
+                   choices=["capacity", "model-only", "hybrid"])
+    p.add_argument("--reconcile-interval", type=float, default=60.0)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8443)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    spec = VariantAutoscalingSpec(
+        model_id=args.model_id, accelerator=args.accelerator,
+        slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        scale_to_zero=args.scale_to_zero, mode=args.mode)
+    wva = VariantAutoscaler(spec, args.endpoints.split(","),
+                            reconcile_interval_s=args.reconcile_interval)
+    web.run_app(wva.build_app(), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,13 @@ class EngineConfig:
     # amortizes host<->device transfer latency (the reference's
     # --async-scheduling analogue; decode.yaml:77,97).
     num_scheduler_steps: int = 1
+    # EPLB (MoE models): redundant-expert load balancing
+    # (reference: --enable-eplb --eplb-config, decode.yaml:79,100-104).
+    enable_eplb: bool = False
+    eplb_config: Optional[Dict[str, Any]] = None
+    # Tiered prefix cache: host-RAM blocks surviving device eviction
+    # (reference: tiered-prefix-cache/cpu, OffloadingConnector role).
+    kv_offload_blocks: int = 0            # 0 = off
 
     def resolve_model(self) -> ModelConfig:
         return self.model_config or get_config(self.model)
@@ -109,6 +116,14 @@ class EngineCore:
             params = self.model.init_params(c, jax.random.PRNGKey(config.seed))
         shardings = logical_to_sharding(rules, params, self.mesh)
         self.params = shard_pytree(params, shardings)
+        self.eplb = None
+        if config.enable_eplb and c.is_moe:
+            from llm_d_tpu.parallel.eplb import EplbConfig, EplbController
+            self.eplb = EplbController(
+                c.num_experts, self.mesh.devices.size,
+                EplbConfig.from_dict(config.eplb_config))
+            # Physical expert table replaces the logical weights on device.
+            self.params = self.eplb.install(self.params, self.mesh, rules)
 
         num_slots = config.num_blocks * config.block_size
         # Folded layout [L, slots, KVH*D]: 128-lane-aligned page DMAs and
@@ -145,6 +160,11 @@ class EngineCore:
         self._last_evictions = 0
         self._last_preemptions = 0
 
+        self.host_tier = None
+        if config.kv_offload_blocks > 0:
+            from llm_d_tpu.engine.offload import HostKVTier
+            self.host_tier = HostKVTier(self, config.kv_offload_blocks)
+
         self._step_fn = self._build_step_fn()
         self._multistep_fn = (
             self._build_multistep_fn(config.num_scheduler_steps)
@@ -158,16 +178,24 @@ class EngineCore:
         backend = self.config.attn_backend
         model, mesh = self.model, self.mesh
 
+        collect_routed = self.eplb is not None
+
         @functools.partial(jax.jit, donate_argnums=(1,))
         def step_fn(params, kv_cache, batch, rng):
-            hidden, kv_cache = model.forward(
-                params, kv_cache, batch, c, block_size, backend, mesh=mesh)
+            if collect_routed:
+                hidden, kv_cache, routed = model.forward(
+                    params, kv_cache, batch, c, block_size, backend,
+                    mesh=mesh, collect_routed=True)
+            else:
+                hidden, kv_cache = model.forward(
+                    params, kv_cache, batch, c, block_size, backend, mesh=mesh)
+                routed = None
             logits = model.compute_logits(params, hidden, c)
             ids = sampling_ops.sample(
                 logits, batch["temperature"], batch["top_k"], batch["top_p"],
                 rng, seeds=batch["seeds"], gen_idx=batch["gen_idx"])
             logprobs = sampling_ops.compute_logprobs(logits, ids)
-            return ids, logprobs, kv_cache
+            return ids, logprobs, kv_cache, routed
 
         return step_fn
 
@@ -178,6 +206,8 @@ class EngineCore:
         block_size = self.config.block_size
         backend = self.config.attn_backend
         model, mesh = self.model, self.mesh
+
+        collect_routed = self.eplb is not None
 
         @functools.partial(jax.jit, static_argnums=(), donate_argnums=(1,))
         def multistep_fn(params, kv_cache, mbatch, rng):
@@ -203,22 +233,29 @@ class EngineCore:
                     sample_idx=jnp.arange(S, dtype=jnp.int32),
                     qtok_idx=jnp.arange(S, dtype=jnp.int32)[:, None],
                 )
-                hidden, kv_cache = model.forward(
-                    params, kv_cache, batch, c, block_size, backend, mesh=mesh)
+                if collect_routed:
+                    hidden, kv_cache, routed = model.forward(
+                        params, kv_cache, batch, c, block_size, backend,
+                        mesh=mesh, collect_routed=True)
+                else:
+                    hidden, kv_cache = model.forward(
+                        params, kv_cache, batch, c, block_size, backend,
+                        mesh=mesh)
+                    routed = jnp.zeros((), jnp.int32)
                 logits = model.compute_logits(params, hidden, c)
                 ids = sampling_ops.sample(
                     logits, mbatch["temperature"], mbatch["top_k"],
                     mbatch["top_p"], key, seeds=mbatch["seeds"],
                     gen_idx=mbatch["gen0"] + it)
                 ids = jnp.where(mbatch["active"], ids, 0)
-                return (kv_cache, ids, pos0 + 1), ids
+                return (kv_cache, ids, pos0 + 1), (ids, routed)
 
             keys = jax.random.split(rng, K)
-            (kv_cache, _, _), ids_ks = jax.lax.scan(
+            (kv_cache, _, _), (ids_ks, routed_ks) = jax.lax.scan(
                 one_iter, (kv_cache, mbatch["last_ids"],
                            mbatch["pos0"]),
                 (keys, jnp.arange(K, dtype=jnp.int32)))
-            return ids_ks, kv_cache   # [K, S]
+            return ids_ks, kv_cache, routed_ks   # [K, S], ..., [K, Lm, S, k]
 
         return multistep_fn
 
@@ -288,10 +325,16 @@ class EngineCore:
             seeds=jnp.asarray(seeds), gen0=jnp.asarray(gen0)),
             self._replicated)
         self._rng, step_key = jax.random.split(self._rng)
-        ids_ks, self.kv_cache = self._multistep_fn(
+        ids_ks, self.kv_cache, routed_ks = self._multistep_fn(
             self.params, self.kv_cache, mbatch, step_key)
         ids_ks = np.asarray(jax.device_get(ids_ks))   # [K, S]
         self._step_count += K
+        if self.eplb is not None:
+            # Fused decode is EXACTLY the traffic EPLB exists to balance;
+            # only the first S_real rows are real sequences.
+            self.params = self.eplb.on_step(
+                routed_ks[:, :, :S_real, :], self._step_count,
+                self.params, self.mesh)
 
         outputs: List[RequestOutput] = []
         now = time.monotonic()
@@ -480,11 +523,19 @@ class EngineCore:
 
         batch, scheduled = self._build_batch(sched)
         self._rng, step_key = jax.random.split(self._rng)
-        ids, logprobs, self.kv_cache = self._step_fn(
+        ids, logprobs, self.kv_cache, routed = self._step_fn(
             self.params, self.kv_cache, batch, step_key)
         ids = np.asarray(jax.device_get(ids))
         logprobs = np.asarray(jax.device_get(logprobs))
         self._step_count += 1
+        if self.eplb is not None:
+            # Record routed logical ids (sampled; padding rows excluded so
+            # the zero-embedding's favorite expert doesn't skew the stats)
+            # and rebalance the physical placement on the interval.
+            if routed is not None:
+                routed = routed[:, :sched.total_tokens, :]
+            self.params = self.eplb.on_step(
+                routed, self._step_count, self.params, self.mesh)
 
         now = time.monotonic()
         for s, sr in enumerate(scheduled):
@@ -577,6 +628,9 @@ class EngineCore:
         return None
 
     def _update_queue_metrics(self) -> None:
+        if self.host_tier is not None:
+            # One batched device->host copy for all blocks cached this step.
+            self.host_tier.flush()
         self.metrics.num_requests_waiting.set(self.scheduler.num_waiting)
         self.metrics.num_requests_running.set(self.scheduler.num_running)
         self.metrics.kv_cache_usage_perc.set(self.kv_manager.usage)

@@ -51,6 +51,11 @@ class KVCacheManager:
 
         self.on_block_stored: List[BlockEvent] = []      # KV events / offload
         self.on_block_removed: List[BlockEvent] = []
+        # Tiered cache: consulted on device-cache miss with (block_hash,
+        # protected chain blocks); returns a restored (cached,
+        # evictor-parked) block id or None (engine/offload.py).
+        self.secondary_lookup: Optional[
+            Callable[[bytes, frozenset], Optional[int]]] = None
         self.eviction_count = 0
 
     # ---------- introspection ----------
@@ -89,6 +94,11 @@ class KVCacheManager:
         blocks: List[int] = []
         for h in self.request_block_hashes(request):
             b = self._cached.get(h)
+            if b is None and self.secondary_lookup is not None:
+                # Host-tier restore on miss; earlier chain blocks are
+                # refcount-0 evictor residents and must not be reused as
+                # the restore target (silent chain corruption).
+                b = self.secondary_lookup(h, frozenset(blocks))
             if b is None:
                 break
             blocks.append(b)

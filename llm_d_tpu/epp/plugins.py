@@ -35,6 +35,15 @@ class RequestCtx:
     token_ids: Optional[Sequence[int]] = None
     headers: Dict[str, str] = dataclasses.field(default_factory=dict)
     request_id: str = ""
+    # SLO-aware path (reference: x-prediction-based-scheduling,
+    # x-slo-ttft-ms, x-slo-tpot-ms headers; priority<0 sheddable).
+    in_headers: Dict[str, str] = dataclasses.field(default_factory=dict)
+    prediction_based: bool = False
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
+    priority: int = 0
+    shed: bool = False
+    predictions: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def block_keys(self, block_size: int) -> List[bytes]:
         """Chain block hashes for prefix scoring: token ids when present
@@ -234,6 +243,196 @@ class RandomPicker(Plugin):
         return random.choice(ranked[:max(1, n)])
 
 
+# ---------- SLO-aware scheduling (predicted-latency path) ----------
+
+class AnalyticLatencyPredictor:
+    """Default predictor: latency from an endpoint's live load signals.
+
+    Stands in for the prediction sidecars when none are deployed — the same
+    feature set the trained models consume (queue depth, running batch, KV
+    utilization), with linear coefficients instead of learned ones."""
+
+    def __init__(self, params: Dict[str, Any]) -> None:
+        self.ttft_base_ms = float(params.get("ttftBaseMs", 50.0))
+        self.ttft_per_waiting_ms = float(params.get("ttftPerWaitingMs", 80.0))
+        self.ttft_per_prompt_tok_ms = float(
+            params.get("ttftPerPromptTokenMs", 0.1))
+        self.tpot_base_ms = float(params.get("tpotBaseMs", 8.0))
+        self.tpot_per_running_ms = float(params.get("tpotPerRunningMs", 0.5))
+
+    def predict(self, e: EndpointState,
+                prompt_tokens: float = 0.0) -> Dict[str, float]:
+        kv_slow = 1.0 / max(1e-3, 1.0 - min(e.kv_usage, 0.99))
+        return {
+            "ttft_ms": (self.ttft_base_ms
+                        + self.ttft_per_waiting_ms * e.num_waiting
+                        + self.ttft_per_prompt_tok_ms * prompt_tokens)
+            * kv_slow,
+            "tpot_ms": (self.tpot_base_ms
+                        + self.tpot_per_running_ms * e.num_running) * kv_slow,
+        }
+
+
+class HttpLatencyPredictor:
+    """Prediction-sidecar client (reference: PREDICTION_SERVER_URL CSV).
+
+    Round-robins the sidecars; per-endpoint results are cached briefly so
+    per-request scoring doesn't multiply HTTP round-trips (the reference
+    documents ~300 QPS/sidecar as the scaling limit)."""
+
+    def __init__(self, urls: Sequence[str], cache_ttl_s: float = 0.2,
+                 timeout_s: float = 0.1) -> None:
+        self.urls = [u.rstrip("/") for u in urls]
+        self.cache_ttl_s = cache_ttl_s
+        self.timeout_s = timeout_s
+        self._cache: Dict[tuple, tuple] = {}
+        self._rr = 0
+        # Sidecar failure must NOT score as zero latency (that would place
+        # the failing endpoint in the best bucket); fall back to the
+        # analytic estimate instead.
+        self._fallback = AnalyticLatencyPredictor({})
+
+    def predict(self, e: EndpointState,
+                prompt_tokens: float = 0.0) -> Dict[str, float]:
+        import json as _json
+        import urllib.request
+
+        now = time.monotonic()
+        # Predictions vary with prompt length; bucket it for the cache.
+        key = (e.address, int(prompt_tokens) // 256)
+        hit = self._cache.get(key)
+        if hit and now - hit[0] < self.cache_ttl_s:
+            return hit[1]
+        feats = {"num_waiting": e.num_waiting, "num_running": e.num_running,
+                 "kv_usage": e.kv_usage, "prompt_tokens": prompt_tokens}
+        url = self.urls[self._rr % len(self.urls)]
+        self._rr += 1
+        try:
+            req = urllib.request.Request(
+                f"{url}/predict",
+                data=_json.dumps({"features": feats}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                out = _json.loads(resp.read())
+            if not out.get("ttft_ms") and not out.get("tpot_ms"):
+                # Untrained model: same hazard as a failure.
+                out = self._fallback.predict(e, prompt_tokens)
+        except Exception:
+            out = self._fallback.predict(e, prompt_tokens)
+        self._cache[key] = (now, out)
+        return out
+
+
+class SloRequestTracker(Plugin):
+    """Captures per-request SLOs from the prediction headers (reference:
+    slo-request-tracker; README.md:271-272,99-107)."""
+
+    def score(self, ctx, candidates):
+        h = ctx.in_headers
+        ctx.prediction_based = h.get(
+            "x-prediction-based-scheduling", "").lower() in ("true", "1")
+        try:
+            if "x-slo-ttft-ms" in h:
+                ctx.slo_ttft_ms = float(h["x-slo-ttft-ms"])
+            if "x-slo-tpot-ms" in h:
+                ctx.slo_tpot_ms = float(h["x-slo-tpot-ms"])
+        except (TypeError, ValueError) as e:
+            # Client-controlled input: surfaces as a 400 at the gateway.
+            raise ValueError(f"invalid SLO header: {e}") from e
+        return None
+
+
+class SloScorer(Plugin):
+    """Predicted TTFT/TPOT vs SLOs -> positive/negative headroom buckets
+    (reference: slo-scorer + HEADROOM_* env knobs, README.md:296-305).
+
+    Positive bucket (both SLOs met) always outranks negative; within a
+    bucket, headroom blends with the ttft/tpot weights and the selection
+    strategy ('least' packs, 'most' spreads).  When no pod meets the SLOs
+    and the request's priority < 0, it is marked shed (the gateway answers
+    429 instead of queueing it; README.md:190-192)."""
+
+    def __init__(self, name, params, datastore, predictor=None):
+        super().__init__(name, params, datastore)
+        urls = params.get("predictionServerURL")
+        if predictor is not None:
+            self.predictor = predictor
+        elif urls:
+            self.predictor = HttpLatencyPredictor(str(urls).split(","))
+        else:
+            self.predictor = AnalyticLatencyPredictor(params)
+        self.w_ttft = float(params.get("headroomTtftWeight", 0.5))
+        self.w_tpot = float(params.get("headroomTpotWeight", 0.5))
+        self.neg_w_ttft = float(params.get("negHeadroomTtftWeight", 0.5))
+        self.neg_w_tpot = float(params.get("negHeadroomTpotWeight", 0.5))
+        self.strategy = params.get("headroomSelectionStrategy", "least")
+        self.slo_buffer = float(params.get("sloBufferFactor", 1.0))
+
+    def score(self, ctx, candidates):
+        if not candidates:
+            return None
+        # No SLOs provided => SLO=0: pure lowest-predicted-latency pick
+        # (reference: "treated as SLO=0 -> lowest latency pod").
+        slo_ttft = ctx.slo_ttft_ms if ctx.slo_ttft_ms is not None else 0.0
+        slo_tpot = (ctx.slo_tpot_ms if ctx.slo_tpot_ms is not None
+                    else 0.0) * self.slo_buffer
+        n_tokens = float(len(ctx.token_ids) if ctx.token_ids
+                         else len(ctx.prompt_text) // 4)
+        head: Dict[str, tuple] = {}
+        preds: Dict[str, Dict[str, float]] = {}
+        any_positive = False
+        for e in candidates:
+            pred = self.predictor.predict(e, prompt_tokens=n_tokens)
+            preds[e.address] = pred
+            h_ttft = slo_ttft - pred["ttft_ms"]
+            h_tpot = slo_tpot - pred["tpot_ms"]
+            positive = h_ttft >= 0 and h_tpot >= 0
+            any_positive = any_positive or positive
+            head[e.address] = (positive, h_ttft, h_tpot)
+        if ctx.slo_ttft_ms is not None and not any_positive \
+                and ctx.priority < 0:
+            ctx.shed = True
+        out: Scores = {}
+        # Buckets normalize separately: within POSITIVE the strategy
+        # applies ('least' headroom packs, 'most' spreads); within NEGATIVE
+        # the least deficit always wins; positive strictly outranks.
+        pos_blend = {a: self.w_ttft * t + self.w_tpot * p
+                     for a, (pos, t, p) in head.items() if pos}
+        neg_blend = {a: self.neg_w_ttft * t + self.neg_w_tpot * p
+                     for a, (pos, t, p) in head.items() if not pos}
+        pos_n = _minmax(pos_blend, invert=(self.strategy == "least"))
+        neg_n = _minmax(neg_blend)
+        for e in candidates:
+            a = e.address
+            # Positive maps into [0.55, 1.0], negative into [0, 0.45]:
+            # the buckets can never tie, whatever the strategy inversion.
+            out[a] = 0.55 + 0.45 * pos_n[a] if a in pos_n \
+                else 0.45 * neg_n.get(a, 0.0)
+        # Stash per-endpoint predictions; on_picked binds the ACTUAL pick's
+        # prediction to the ctx for the usage frame.
+        ctx._slo_pred_map = preds
+        return out
+
+    def on_picked(self, ctx, endpoint, profile):
+        pred_map = getattr(ctx, "_slo_pred_map", None)
+        if pred_map and endpoint.address in pred_map:
+            ctx.predictions = pred_map[endpoint.address]
+
+
+class SloAwareProfileHandler(Plugin):
+    """Routes prediction-based requests onto the ``slo`` profile
+    (reference: slo-aware-profile-handler, README.md:273,285-291)."""
+
+    def profiles(self, ctx: RequestCtx, available: List[str]) -> List[str]:
+        h = ctx.in_headers
+        prediction = h.get(
+            "x-prediction-based-scheduling", "").lower() in ("true", "1")
+        if prediction and "slo" in available:
+            return ["slo"]
+        defaults = [p for p in available if p != "slo"]
+        return defaults[:1] if defaults else available[:1]
+
+
 # ---------- profile handlers ----------
 
 class SingleProfileHandler(Plugin):
@@ -294,4 +493,7 @@ PLUGIN_TYPES = {
     "single-profile-handler": SingleProfileHandler,
     "pd-profile-handler": PdProfileHandler,
     "prefill-header-handler": PrefillHeaderHandler,
+    "slo-request-tracker": SloRequestTracker,
+    "slo-scorer": SloScorer,
+    "slo-aware-profile-handler": SloAwareProfileHandler,
 }

@@ -25,6 +25,7 @@ from llm_d_tpu.epp.plugins import (
     PrecisePrefixCacheScorer,
     RequestCtx,
     SingleProfileHandler,
+    SloAwareProfileHandler,
 )
 from llm_d_tpu.utils.metrics import EppMetrics
 
@@ -70,10 +71,15 @@ class EppScheduler:
             else:
                 inst = cls(spec.name, spec.parameters, datastore)
             self.plugins[spec.name] = inst
-        self._profile_handler = next(
-            (p for p in self.plugins.values()
-             if isinstance(p, (SingleProfileHandler, PdProfileHandler))),
-            None)
+        # Most specific handler wins: slo-aware > pd > single.
+        self._profile_handler = None
+        for kinds in (SloAwareProfileHandler, PdProfileHandler,
+                      SingleProfileHandler):
+            self._profile_handler = next(
+                (p for p in self.plugins.values() if isinstance(p, kinds)),
+                None)
+            if self._profile_handler is not None:
+                break
 
     # ---------- per-request ----------
 

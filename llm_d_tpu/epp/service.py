@@ -107,17 +107,38 @@ class Gateway:
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
 
-        ctx = self._make_ctx(body, request)
-        result = self.scheduler.schedule(ctx)
+        try:
+            ctx = self._make_ctx(body, request)
+            # Scoring may block (prediction-sidecar HTTP, lock contention):
+            # keep it off the event loop so streaming relays never stall.
+            result = await asyncio.to_thread(self.scheduler.schedule, ctx)
+        except (TypeError, ValueError) as exc:
+            return web.json_response(
+                {"error": f"invalid request: {exc}"}, status=400)
+        if ctx.shed:
+            # No pod can meet the SLOs and the request is sheddable
+            # (priority < 0): refuse instead of queueing it in the
+            # negative bucket (reference: README.md:190-192).
+            self.scheduler.metrics.shed_total.inc()
+            return web.json_response(
+                {"error": "shed: no endpoint meets the requested SLOs"},
+                status=429)
         primary = result.primary
         if primary is None:
             return web.json_response(
                 {"error": "no ready endpoints"}, status=503)
+        if ctx.predictions:
+            # Ride the predictions to the model server so its usage frame
+            # can report predicted vs actual (reference SSE usage contract,
+            # README.md:130-148).
+            body = dict(body)
+            body["_predicted"] = ctx.predictions
 
         # PD: hand the sidecar its prefill hint via the request headers.
         fwd_headers = {k: v for k, v in result.headers.items()
                        if k != DESTINATION_HEADER}
         url = f"{primary.url}{request.path}"
+        resp = None
         try:
             # No total timeout: it would count SSE streaming time and sever
             # long generations mid-stream; connect failures surface fast.
@@ -136,6 +157,10 @@ class Gateway:
                 await resp.write_eof()
                 return resp
         except aiohttp.ClientError as exc:
+            if resp is not None:
+                # Headers already went out: a second (json) response would
+                # corrupt the half-sent stream — close it truncated.
+                return resp
             return web.json_response(
                 {"error": f"upstream {primary.address} failed: {exc}"},
                 status=502)
@@ -153,6 +178,9 @@ class Gateway:
                            for m in body.get("messages", []))
         return RequestCtx(body=body, prompt_text=text, token_ids=token_ids,
                           headers={},
+                          in_headers={k.lower(): v
+                                      for k, v in request.headers.items()},
+                          priority=int(body.get("priority") or 0),
                           request_id=body.get("request_id", ""))
 
 

@@ -108,7 +108,8 @@ def forward(
     block_size: int,
     attn_backend: str = "auto",
     mesh: Optional[Mesh] = None,
-) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    collect_routed: bool = False,   # also return [Lm, T, k] routed ids (EPLB)
+):
     c = config
     Ld = c.first_dense_layers
     x = params["embed"][batch["token_ids"]]
@@ -136,22 +137,32 @@ def forward(
         weights, idx = moe_ops.route(
             jnp.dot(hn.astype(jnp.float32), lp["router"]), c,
             e_bias=lp.get("e_bias"))
+        if "replica_table" in lp:
+            # EPLB: route to a physical replica of the logical expert
+            # (round-robin over its replicas; parallel.eplb plans the table).
+            phys_idx = moe_ops.to_physical_experts(
+                idx, lp["replica_table"], lp["num_replicas"])
+        else:
+            phys_idx = idx
         m = moe_ops.expert_ffn(
-            hn, weights, idx, lp["w_gate"], lp["w_up"], lp["w_down"],
+            hn, weights, phys_idx, lp["w_gate"], lp["w_up"], lp["w_down"],
             mesh=mesh)
         if "shared_gate" in lp:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])
-        return (h + m, kv_k, kv_v, li + 1), None
+        return (h + m, kv_k, kv_v, li + 1), idx
 
     (x, k_new, v_new, li), _ = jax.lax.scan(
         dense_body, (x, kv_cache["k"], kv_cache["v"], jnp.int32(0)),
         params["dense_layers"])
-    (x, k_new, v_new, _), _ = jax.lax.scan(
+    (x, k_new, v_new, _), routed = jax.lax.scan(
         moe_body, (x, k_new, v_new, li), params["moe_layers"])
 
     x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
     sample_hidden = x[batch["sample_idx"]]
+    if collect_routed:
+        # [Lm, T, k] logical ids for the engine's EPLB LoadTracker.
+        return sample_hidden, {"k": k_new, "v": v_new}, routed
     return sample_hidden, {"k": k_new, "v": v_new}
 
 

@@ -23,9 +23,12 @@ Plan algorithm (greedy, deterministic):
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+import logging
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -148,3 +151,152 @@ class LoadTracker:
         """max/mean per-expert load (1.0 = perfectly even)."""
         mean = self.load.mean()
         return float(self.load.max() / mean) if mean > 0 else 1.0
+
+
+@dataclasses.dataclass
+class EplbConfig:
+    """Engine-facing knobs mirroring the reference's ``--eplb-config``
+    (decode.yaml:79,100-104)."""
+    num_redundant_experts: int = 0       # 0 -> auto: pad E to ep multiple + ep
+    window_size: int = 1000
+    step_interval: int = 3000            # engine steps between rebalances
+    record_interval: int = 1             # sample routed ids every N steps
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "EplbConfig":
+        d = d or {}
+        return cls(
+            num_redundant_experts=int(d.get("num_redundant_experts", 0)),
+            window_size=int(d.get("window_size", 1000)),
+            step_interval=int(d.get("step_interval", 3000)),
+            record_interval=int(d.get("record_interval", 1)))
+
+
+class EplbController:
+    """Serving-path EPLB: installs the physical expert table into a MoE
+    model's params, records routed logical ids, and applies rebalances as
+    on-device gathers (no logical-weight copy is kept: every logical expert
+    always has >= 1 physical replica, so a new placement is a permutation
+    gather of the current physical weights).
+
+    One plan is shared by all MoE layers (load is aggregated across layers);
+    per-layer plans are a straightforward extension — the replica tables
+    are already stacked per layer for the scan.
+    """
+
+    def __init__(self, num_experts: int, ep: int, config: EplbConfig) -> None:
+        self.E = num_experts
+        self.ep = ep
+        self.config = config
+        r = config.num_redundant_experts
+        if r <= 0:
+            # Auto: one extra slot per shard after padding E up to a multiple.
+            r = (-num_experts) % ep + ep
+        # Feasibility: every replica of one expert must land on a distinct
+        # shard (c <= ep), so at most E*(ep-1) redundant slots exist — on a
+        # single shard (ep=1) redundancy is meaningless and clamps to 0.
+        r_max = num_experts * (ep - 1)
+        if r > r_max:
+            logger.warning("eplb: clamping num_redundant_experts %d -> %d "
+                           "(E=%d, ep=%d)", r, r_max, num_experts, ep)
+            r = r_max
+        r -= (num_experts + r) % ep     # keep the divisibility constraint
+        if r < 0 or (num_experts + r) % ep:
+            raise ValueError(
+                f"(experts {num_experts} + redundant {r}) must divide over "
+                f"ep={ep} (reference constraint, decode.yaml:100-104)")
+        self.num_redundant = r
+        # Static replica-table width: an expert with c replicas consumes
+        # c - 1 redundant slots, so c <= r + 1 (and > ep adds nothing).
+        self.max_r = min(ep, r + 1)
+        self.plan = plan_placement(np.ones(num_experts), r, ep)
+        self.tracker = LoadTracker(num_experts, config.window_size)
+        self.num_rebalances = 0
+        self._last_rebalance_step = 0
+
+    # ---------- param plumbing ----------
+
+    def _stacked_tables(self, n_layers: int):
+        import jax.numpy as jnp
+        rt = np.zeros((self.E, self.max_r), np.int32)
+        rt[:, :self.plan.replica_table.shape[1]] = self.plan.replica_table
+        for e in range(self.E):
+            rt[e, self.plan.num_replicas[e]:] = rt[e, 0]
+        return (
+            jnp.asarray(np.broadcast_to(rt, (n_layers, *rt.shape))),
+            jnp.asarray(np.broadcast_to(
+                self.plan.num_replicas, (n_layers, self.E))))
+
+    def install(self, params: Dict[str, Any], mesh, sharding_rules) -> Dict[str, Any]:
+        """Replace logical expert weights with the physical table.
+
+        ``params['moe_layers']['w_{gate,up,down}']``: [Lm, E, ...] ->
+        [Lm, P, ...] gathered by the initial plan and re-placed with the EP
+        sharding; replica tables join the layer stack (replicated)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from llm_d_tpu.parallel.mesh import AXIS_EP
+
+        ml = dict(params["moe_layers"])
+        n_layers = ml["router"].shape[0]
+        phys = jax.numpy.asarray(self.plan.phys_to_logical)
+        ep_sharding = NamedSharding(mesh, P(None, AXIS_EP))
+        for name in ("w_gate", "w_up", "w_down"):
+            ml[name] = jax.device_put(ml[name][:, phys], ep_sharding)
+        rt, nr = self._stacked_tables(n_layers)
+        repl = NamedSharding(mesh, P())
+        ml["replica_table"] = jax.device_put(rt, repl)
+        ml["num_replicas"] = jax.device_put(nr, repl)
+        out = dict(params)
+        out["moe_layers"] = ml
+        return out
+
+    # ---------- serving loop hooks ----------
+
+    def on_step(self, routed_ids, step: int, params: Dict[str, Any],
+                mesh) -> Dict[str, Any]:
+        """Record this step's routed logical ids (sampled) and rebalance on
+        the interval.  Returns (possibly updated) params."""
+        c = self.config
+        if step % c.record_interval == 0 and routed_ids is not None:
+            self.tracker.record(np.asarray(routed_ids))
+        # Interval CROSSING, not modulo: fused multi-step decode advances
+        # the step counter by K, which would skip `step % interval == 0`
+        # forever and silently disable rebalancing.
+        if step - self._last_rebalance_step >= c.step_interval \
+                and self.tracker.load.sum() > 0:
+            self._last_rebalance_step = step
+            params = self.rebalance(params, mesh)
+        return params
+
+    def rebalance(self, params: Dict[str, Any], mesh) -> Dict[str, Any]:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from llm_d_tpu.parallel.mesh import AXIS_EP
+
+        new_plan = plan_placement(
+            self.tracker.load + 1e-9, self.num_redundant, self.ep)
+        if np.array_equal(new_plan.phys_to_logical,
+                          self.plan.phys_to_logical):
+            return params
+        # New physical slot p holds logical e = new.phys_to_logical[p];
+        # source it from the CURRENT canonical replica of e: one on-device
+        # permutation gather, re-placed with the EP sharding.
+        src = self.plan.replica_table[new_plan.phys_to_logical, 0]
+        src_dev = jax.numpy.asarray(src)
+        ep_sharding = NamedSharding(mesh, P(None, AXIS_EP))
+        ml = dict(params["moe_layers"])
+        for name in ("w_gate", "w_up", "w_down"):
+            ml[name] = jax.device_put(ml[name][:, src_dev], ep_sharding)
+        self.plan = new_plan
+        n_layers = ml["router"].shape[0]
+        rt, nr = self._stacked_tables(n_layers)
+        repl = NamedSharding(mesh, P())
+        ml["replica_table"] = jax.device_put(rt, repl)
+        ml["num_replicas"] = jax.device_put(nr, repl)
+        self.num_rebalances += 1
+        logger.info("EPLB rebalance #%d applied (imbalance %.2f)",
+                    self.num_rebalances, self.tracker.imbalance())
+        out = dict(params)
+        out["moe_layers"] = ml
+        return out

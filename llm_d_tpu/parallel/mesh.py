@@ -84,3 +84,32 @@ def make_mesh(
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     device = device or jax.devices()[0]
     return make_mesh(MeshConfig(), [device])
+
+
+def lws_distributed_args(env: Optional[dict] = None,
+                         coordinator_port: int = 8476) -> Optional[dict]:
+    """LeaderWorkerSet rank bootstrap -> jax.distributed.initialize kwargs.
+
+    The reference derives multi-host ranks from LWS-injected env
+    (``LWS_LEADER_ADDRESS``, ``LWS_GROUP_SIZE``, ``LWS_WORKER_INDEX``;
+    decode.yaml:73,89-93).  Returns None when not running under LWS."""
+    import os
+    env = env if env is not None else os.environ
+    leader = env.get("LWS_LEADER_ADDRESS")
+    if not leader:
+        return None
+    if ":" not in leader:
+        leader = f"{leader}:{coordinator_port}"
+    return dict(
+        coordinator_address=leader,
+        num_processes=int(env.get("LWS_GROUP_SIZE", "1")),
+        process_id=int(env.get("LWS_WORKER_INDEX", "0")))
+
+
+def maybe_init_distributed() -> bool:
+    """Join the slice-wide JAX process group when launched under LWS."""
+    args = lws_distributed_args()
+    if args is None:
+        return False
+    jax.distributed.initialize(**args)
+    return True

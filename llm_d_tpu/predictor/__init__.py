@@ -1,0 +1,1 @@
+from llm_d_tpu.predictor.model import LatencyModel, TrainingStore  # noqa: F401

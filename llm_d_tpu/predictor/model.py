@@ -1,0 +1,128 @@
+"""Online p90 latency models (the XGBoost-sidecar role).
+
+The reference trains per-pod XGBoost models of p90 TTFT/TPOT online:
+retrain every 1 s once >=100 samples, capped training buckets, p90 the only
+supported percentile (reference: predicted-latency-based-scheduling/
+README.md:234-244, latency-predictor-config — LATENCY_RETRAINING_INTERVAL_SEC
+1, LATENCY_MIN_SAMPLES_FOR_RETRAIN 100, MAX_TRAINING_DATA_SIZE_PER_BUCKET
+5000).
+
+XGBoost isn't in this image; the TPU stack uses standardized ridge
+regression plus a tracked residual p90 — the same "conditional mean +
+spread" decomposition, closed-form (deterministic, dependency-free), and
+serializable as plain JSON so prediction sidecars sync it over HTTP instead
+of joblib volumes.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+TTFT_FEATURES = ("num_waiting", "num_running", "kv_usage", "prompt_tokens")
+TPOT_FEATURES = ("num_waiting", "num_running", "kv_usage")
+
+
+class LatencyModel:
+    """Ridge mean-model + residual p90 for one target (ttft or tpot)."""
+
+    def __init__(self, features: Sequence[str], l2: float = 1e-3) -> None:
+        self.features = tuple(features)
+        self.l2 = l2
+        self.coef: Optional[np.ndarray] = None    # [F + 1] incl. bias
+        self.x_mean = np.zeros(len(self.features))
+        self.x_std = np.ones(len(self.features))
+        self.residual_p90 = 0.0
+        self.num_trained_on = 0
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> None:
+        """Closed-form ridge on standardized features; p90 of residuals."""
+        assert X.shape[1] == len(self.features)
+        self.x_mean = X.mean(axis=0)
+        self.x_std = np.maximum(X.std(axis=0), 1e-9)
+        Z = (X - self.x_mean) / self.x_std
+        Zb = np.concatenate([Z, np.ones((len(Z), 1))], axis=1)
+        A = Zb.T @ Zb + self.l2 * np.eye(Zb.shape[1])
+        self.coef = np.linalg.solve(A, Zb.T @ y)
+        resid = y - Zb @ self.coef
+        self.residual_p90 = float(np.percentile(resid, 90))
+        self.num_trained_on = len(y)
+
+    def predict(self, feats: Dict[str, float]) -> float:
+        """p90 latency estimate (ms); conservative prior when untrained."""
+        if self.coef is None:
+            return 0.0
+        x = np.asarray([float(feats.get(f, 0.0)) for f in self.features])
+        z = (x - self.x_mean) / self.x_std
+        mean = float(np.concatenate([z, [1.0]]) @ self.coef)
+        return max(0.0, mean + self.residual_p90)
+
+    # ---------- JSON wire format (sidecar sync) ----------
+
+    def to_dict(self) -> Dict:
+        return {
+            "features": list(self.features),
+            "coef": None if self.coef is None else self.coef.tolist(),
+            "x_mean": self.x_mean.tolist(),
+            "x_std": self.x_std.tolist(),
+            "residual_p90": self.residual_p90,
+            "num_trained_on": self.num_trained_on,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "LatencyModel":
+        m = cls(d["features"])
+        if d.get("coef") is not None:
+            m.coef = np.asarray(d["coef"])
+        m.x_mean = np.asarray(d["x_mean"])
+        m.x_std = np.asarray(d["x_std"])
+        m.residual_p90 = float(d["residual_p90"])
+        m.num_trained_on = int(d.get("num_trained_on", 0))
+        return m
+
+
+class TrainingStore:
+    """Capped sample buckets + retrain policy for both targets."""
+
+    def __init__(self, min_samples: int = 100, bucket_cap: int = 5000) -> None:
+        self.min_samples = min_samples
+        self._lock = threading.Lock()
+        self._samples: Dict[str, collections.deque] = {
+            "ttft": collections.deque(maxlen=bucket_cap),
+            "tpot": collections.deque(maxlen=bucket_cap),
+        }
+        self.models: Dict[str, LatencyModel] = {
+            "ttft": LatencyModel(TTFT_FEATURES),
+            "tpot": LatencyModel(TPOT_FEATURES),
+        }
+        self._dirty = {"ttft": 0, "tpot": 0}
+
+    def add(self, target: str, features: Dict[str, float],
+            actual_ms: float) -> None:
+        with self._lock:
+            self._samples[target].append((dict(features), float(actual_ms)))
+            self._dirty[target] += 1
+
+    def num_samples(self, target: str) -> int:
+        with self._lock:
+            return len(self._samples[target])
+
+    def retrain_if_due(self) -> List[str]:
+        """Retrain targets with >= min_samples and new data; returns them."""
+        trained: List[str] = []
+        for target, model in self.models.items():
+            with self._lock:
+                if (len(self._samples[target]) < self.min_samples
+                        or self._dirty[target] == 0):
+                    continue
+                rows = list(self._samples[target])
+                self._dirty[target] = 0
+            X = np.asarray([[f.get(name, 0.0) for name in model.features]
+                            for f, _ in rows])
+            y = np.asarray([a for _, a in rows])
+            model.fit(X, y)
+            trained.append(target)
+        return trained

@@ -1,0 +1,169 @@
+"""Latency predictor sidecars: one training server + N prediction servers.
+
+Mirrors the reference's in-pod sidecar topology (reference:
+predicted-latency-based-scheduling/README.md:100-110 — training on :8000,
+prediction on :8001-8003; retrain every 1 s with >= 100 samples; prediction
+servers load the trainer's model artifacts).  Artifact sync here is an
+HTTP GET of the JSON-serialized model (no shared joblib volume needed).
+
+  training server:   POST /samples  {"target": "ttft", "features": {...},
+                                     "actual_ms": 57.1}  (list form too)
+                     GET  /model    -> {"ttft": {...}, "tpot": {...}}
+                     GET  /healthz | /readyz
+  prediction server: POST /predict  {"features": {...}}
+                                    -> {"ttft_ms": ..., "tpot_ms": ...}
+                     GET  /healthz | /readyz (ready once a model synced)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Dict, Optional
+
+import aiohttp
+from aiohttp import web
+
+from llm_d_tpu.predictor.model import LatencyModel, TrainingStore
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingServer:
+    def __init__(self, retrain_interval_s: float = 1.0,
+                 min_samples: int = 100, bucket_cap: int = 5000) -> None:
+        self.store = TrainingStore(min_samples=min_samples,
+                                   bucket_cap=bucket_cap)
+        self.retrain_interval_s = retrain_interval_s
+        self._task: Optional[asyncio.Task] = None
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/samples", self.samples)
+        app.router.add_get("/model", self.model)
+        app.router.add_get("/healthz", self._ok)
+        app.router.add_get("/readyz", self._ok)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _on_cleanup(self, app) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        while True:
+            trained = await asyncio.to_thread(self.store.retrain_if_due)
+            if trained:
+                logger.info("retrained %s (samples: %s)", trained,
+                            {t: self.store.num_samples(t) for t in trained})
+            await asyncio.sleep(self.retrain_interval_s)
+
+    async def samples(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        items = body if isinstance(body, list) else [body]
+        n = 0
+        for item in items:
+            target = item.get("target")
+            if target not in ("ttft", "tpot"):
+                continue
+            self.store.add(target, item.get("features", {}),
+                           float(item.get("actual_ms", 0.0)))
+            n += 1
+        return web.json_response({"accepted": n})
+
+    async def model(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {t: m.to_dict() for t, m in self.store.models.items()})
+
+    async def _ok(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+
+class PredictionServer:
+    def __init__(self, training_url: str,
+                 sync_interval_s: float = 1.0) -> None:
+        self.training_url = training_url.rstrip("/")
+        self.sync_interval_s = sync_interval_s
+        self.models: Dict[str, LatencyModel] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/predict", self.predict)
+        app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/readyz", self._readyz)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=2.0))
+        self._task = asyncio.get_running_loop().create_task(self._sync_loop())
+
+    async def _on_cleanup(self, app) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._session:
+            await self._session.close()
+
+    async def _sync_loop(self) -> None:
+        while True:
+            try:
+                async with self._session.get(
+                        f"{self.training_url}/model") as resp:
+                    resp.raise_for_status()
+                    doc = await resp.json()
+                self.models = {t: LatencyModel.from_dict(d)
+                               for t, d in doc.items()}
+            except Exception:
+                pass                      # trainer not up yet; keep old model
+            await asyncio.sleep(self.sync_interval_s)
+
+    async def predict(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        feats = body.get("features", {})
+        out = {}
+        for target, key in (("ttft", "ttft_ms"), ("tpot", "tpot_ms")):
+            m = self.models.get(target)
+            out[key] = m.predict(feats) if m is not None else 0.0
+        return web.json_response(out)
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _readyz(self, request: web.Request) -> web.Response:
+        if not self.models:
+            return web.Response(status=503, text="no model synced")
+        return web.Response(text="ok")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("llmd-predictor")
+    p.add_argument("--role", choices=["training", "prediction"],
+                   default="training")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--training-url", default="http://127.0.0.1:8000",
+                   help="(prediction role) trainer base URL")
+    p.add_argument("--retrain-interval", type=float, default=1.0)
+    p.add_argument("--min-samples", type=int, default=100)
+    p.add_argument("--bucket-cap", type=int, default=5000)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    if args.role == "training":
+        app = TrainingServer(args.retrain_interval, args.min_samples,
+                             args.bucket_cap).build_app()
+    else:
+        app = PredictionServer(args.training_url).build_app()
+    web.run_app(app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -169,11 +169,79 @@ class ModelServer:
             prompt_ids = self.tokenizer.encode(text)
         return await self._run(request, body, prompt_ids, chat=True)
 
+    def _usage(self, req: Request, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Usage block incl. latency actuals (+ gateway predictions when
+        present) — the reference's SSE usage contract surfaces ttft_ms /
+        avg_tpot_ms / predicted_* for accuracy validation (reference:
+        predicted-latency README.md:130-148)."""
+        usage: Dict[str, Any] = {
+            "prompt_tokens": req.num_prompt_tokens,
+            "completion_tokens": len(req.output_token_ids),
+            "total_tokens": req.num_tokens,
+        }
+        if req.first_token_time is not None:
+            usage["ttft_ms"] = round(
+                (req.first_token_time - req.arrival_time) * 1000.0, 3)
+        n_out = len(req.output_token_ids)
+        if (req.last_token_time is not None
+                and req.first_token_time is not None and n_out > 1):
+            usage["avg_tpot_ms"] = round(
+                (req.last_token_time - req.first_token_time)
+                / (n_out - 1) * 1000.0, 3)
+        pred = body.get("_predicted")
+        if pred:
+            usage["predicted_ttft_ms"] = pred.get("ttft_ms")
+            usage["avg_predicted_tpot_ms"] = pred.get("tpot_ms")
+        return usage
+
+    def _post_training_sample(self, req: Request,
+                              feats: Dict[str, float]) -> None:
+        """Fire-and-forget actuals to the latency-training sidecar."""
+        url = getattr(self, "latency_training_url", None)
+        if not url:
+            return
+        samples = []
+        usage = self._usage(req, {})
+        if "ttft_ms" in usage:
+            samples.append({"target": "ttft", "features": feats,
+                            "actual_ms": usage["ttft_ms"]})
+        if "avg_tpot_ms" in usage:
+            tf = {k: feats[k] for k in
+                  ("num_waiting", "num_running", "kv_usage")}
+            samples.append({"target": "tpot", "features": tf,
+                            "actual_ms": usage["avg_tpot_ms"]})
+        if not samples:
+            return
+
+        async def post():
+            try:
+                import aiohttp
+                async with aiohttp.ClientSession(
+                        timeout=aiohttp.ClientTimeout(total=1.0)) as s:
+                    await s.post(f"{url}/samples", json=samples)
+            except Exception:
+                pass
+        # Hold a strong reference: the loop keeps only a weak one, and a
+        # GC'd task silently drops the sample.
+        tasks = getattr(self, "_bg_tasks", None)
+        if tasks is None:
+            tasks = self._bg_tasks = set()
+        task = asyncio.get_running_loop().create_task(post())
+        tasks.add(task)
+        task.add_done_callback(tasks.discard)
+
     async def _run(self, http_req: web.Request, body: Dict[str, Any],
                    prompt_ids: List[int], chat: bool) -> web.StreamResponse:
         req = self._make_request(body, prompt_ids)
         stream = bool(body.get("stream", False))
         created = int(time.time())
+        # Load signals at admission = the predictor sidecars' features.
+        arrival_feats = {
+            "num_waiting": float(self.engine.scheduler.num_waiting),
+            "num_running": float(self.engine.scheduler.num_running),
+            "kv_usage": float(self.engine.kv_manager.usage),
+            "prompt_tokens": float(len(prompt_ids)),
+        }
 
         if stream:
             resp = web.StreamResponse(headers={
@@ -197,8 +265,20 @@ class ModelServer:
                     break
                 if finished:
                     break
+            if bool((body.get("stream_options") or {}).get("include_usage")):
+                usage_chunk = {
+                    "id": req.request_id,
+                    "object": "chat.completion.chunk" if chat
+                    else "text_completion",
+                    "created": created, "model": self.model_name,
+                    "choices": [],
+                    "usage": self._usage(req, body),
+                }
+                await resp.write(b"data: "
+                                 + json.dumps(usage_chunk).encode() + b"\n\n")
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
+            self._post_training_sample(req, arrival_feats)
             return resp
 
         final_out = None
@@ -220,14 +300,11 @@ class ModelServer:
                 **({"message": {"role": "assistant", "content": text}}
                    if chat else {"text": text}),
             }],
-            "usage": {
-                "prompt_tokens": req.num_prompt_tokens,
-                "completion_tokens": len(req.output_token_ids),
-                "total_tokens": req.num_tokens,
-            },
+            "usage": self._usage(req, body),
         }
         if final_out is not None and final_out.kv_transfer_params:
             payload["kv_transfer_params"] = final_out.kv_transfer_params
+        self._post_training_sample(req, arrival_feats)
         return web.json_response(payload)
 
     def _apply_stop_strings(self, req: Request, delta: str, full: str):
@@ -285,6 +362,23 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="permit a mesh smaller than the host's device count "
              "(deliberately idle chips); default is to fail fast")
     p.add_argument(
+        "--latency-training-url", default=None,
+        help="latency-predictor training sidecar base URL; finished "
+             "requests post (features, actual ttft/tpot) samples "
+             "(reference: TRAINING_SERVER_URL)")
+    p.add_argument(
+        "--kv-offload-blocks", type=int, default=0,
+        help="host-RAM tier capacity in KV blocks (0 = off); evicted "
+             "device blocks stay restorable (reference: tiered-prefix-cache)")
+    p.add_argument(
+        "--enable-eplb", action="store_true",
+        help="MoE expert load balancing with redundant experts "
+             "(reference: --enable-eplb, decode.yaml:79)")
+    p.add_argument(
+        "--eplb-config", default=None,
+        help='JSON eplb config, e.g. \'{"window_size":1000,'
+             '"step_interval":3000,"num_redundant_experts":32}\'')
+    p.add_argument(
         "--kv-transfer-config", default=None,
         help="JSON KV-connector config for PD disaggregation, e.g. "
              '\'{"kv_connector":"TPUConnector","kv_role":"kv_producer",'
@@ -300,15 +394,24 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="this replica's address as the EPP sees it (host:port); "
              "defaults to <host>:<port>")
     args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)   # before any startup logs
 
-    from llm_d_tpu.parallel.mesh import MeshConfig
+    from llm_d_tpu.parallel.mesh import MeshConfig, maybe_init_distributed
+    # Multi-host TPU slice: join the process group before touching devices
+    # (LWS env contract; deploy/wide-ep-lws/decode-lws.yaml).
+    if maybe_init_distributed():
+        logger.info("joined LWS process group: %d hosts",
+                    int(__import__("os").environ.get("LWS_GROUP_SIZE", "1")))
     cfg = EngineConfig(
         model=args.model, block_size=args.block_size,
         num_blocks=args.num_blocks, max_num_seqs=args.max_num_seqs,
         max_num_batched_tokens=args.max_num_batched_tokens,
         mesh=MeshConfig(tp=args.tensor_parallel_size)
         if args.tensor_parallel_size > 1 else None,
-        allow_device_subset=args.allow_device_subset)
+        allow_device_subset=args.allow_device_subset,
+        kv_offload_blocks=args.kv_offload_blocks,
+        enable_eplb=args.enable_eplb,
+        eplb_config=json.loads(args.eplb_config) if args.eplb_config else None)
     engine = None
     if args.data_parallel_size > 1:
         # DP = per-rank engine cores over disjoint tp-submeshes behind a
@@ -316,6 +419,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         from llm_d_tpu.engine.dp_group import DPEngineGroup
         engine = DPEngineGroup(cfg, dp_size=args.data_parallel_size)
     server = build_server(cfg, args.tokenizer, engine=engine)
+    if args.latency_training_url:
+        server.latency_training_url = args.latency_training_url.rstrip("/")
     if args.kv_transfer_config:
         from llm_d_tpu.transfer import KVConnectorConfig, TpuConnector
         ktc = json.loads(args.kv_transfer_config)
@@ -352,7 +457,6 @@ def main(argv: Optional[List[str]] = None) -> None:
             publisher.attach(km)
         publisher.start()
         server.kv_event_publisher = publisher
-    logging.basicConfig(level=logging.INFO)
     web.run_app(server.build_app(), host=args.host, port=args.port)
 
 

@@ -87,7 +87,11 @@ class TpuConnector:
         self._pin_times: Dict[str, float] = {}
         # Requests aborted while their KV pull was in flight: dropped at
         # poll() instead of being admitted for a disconnected client.
+        # Only ids with a live pull are tracked (bounded by _pending_ids;
+        # most aborts target already-admitted requests and must not leak
+        # a set entry forever).
         self._aborted: set = set()
+        self._pending_ids: set = set()
 
     # ------------------------------------------------------------------
     # producer side
@@ -126,6 +130,7 @@ class TpuConnector:
         params = req.kv_transfer_params or {}
         with self._inflight_mu:
             self._inflight += 1
+            self._pending_ids.add(req.request_id)
         threading.Thread(
             target=self._fetch_worker, args=(req, params),
             name=f"kv-pull-{req.request_id[:8]}", daemon=True).start()
@@ -150,7 +155,9 @@ class TpuConnector:
 
     def abort(self, request_id: str) -> None:
         """Mark an in-flight pull's request aborted (dropped at poll)."""
-        self._aborted.add(request_id)
+        with self._inflight_mu:
+            if request_id in self._pending_ids:
+                self._aborted.add(request_id)
 
     def has_pending(self) -> bool:
         with self._inflight_mu:
@@ -172,6 +179,11 @@ class TpuConnector:
                 break
             with self._inflight_mu:
                 self._inflight -= 1
+                self._pending_ids.discard(req.request_id)
+            if req.request_id in self._aborted:
+                self._aborted.discard(req.request_id)
+                req.state = RequestState.FINISHED_ABORTED
+                continue
             if error is not None or blob is None:
                 outputs.extend(self._load_failed(engine, req, error or "empty"))
                 continue
@@ -182,11 +194,15 @@ class TpuConnector:
             for r in dropped:
                 r.state = RequestState.FINISHED_ABORTED
                 self._aborted.discard(r.request_id)
+                with self._inflight_mu:
+                    self._pending_ids.discard(r.request_id)
             ready = [(r, b) for r, b in ready
                      if r.state is not RequestState.FINISHED_ABORTED]
 
         for req, blob in ready:
-            out = self._admit(engine, req, blob)
+            with self._inflight_mu:
+                self._pending_ids.discard(req.request_id)
+            out = self._admit(engine, req, blob)   # re-adds if retried
             if out is not None:
                 outputs.append(out)
         return outputs
@@ -198,12 +214,16 @@ class TpuConnector:
         nb = -(-P // bs)
         if not engine.kv_manager.can_allocate(nb):
             # Cache pressure: hold the slab and retry next poll (the blocks
-            # will free as running requests finish).
+            # will free as running requests finish). Still abortable.
             self._retry.append((req, blob))
+            with self._inflight_mu:
+                self._pending_ids.add(req.request_id)
             return None
         attached = engine.kv_manager.allocate(req, P)
         if attached is None:
             self._retry.append((req, blob))
+            with self._inflight_mu:
+                self._pending_ids.add(req.request_id)
             return None
         try:
             _scatter_blocks(engine, req.block_ids, blob)

@@ -1,0 +1,137 @@
+"""WVA autoscaler: collector/analyzer/optimizer decisions + actuator metric.
+
+Reference behaviors pinned: saturation-based scaling from KV utilization and
+queue depth (workload-autoscaling README), modes capacity/model-only/hybrid,
+scaleToZero, and the ``inferno_desired_replicas`` external metric the HPA
+consumes (README.md:145-151,294).
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+import requests
+
+from llm_d_tpu.autoscaler.wva import (
+    CapacityAnalyzer,
+    ModelBasedOptimizer,
+    ReplicaSample,
+    VariantAutoscaler,
+    VariantAutoscalingSpec,
+)
+
+
+def _sample(**kw):
+    s = ReplicaSample(ready=True)
+    for k, v in kw.items():
+        setattr(s, k, v)
+    return s
+
+
+def test_capacity_scales_up_on_saturation():
+    spec = VariantAutoscalingSpec(target_saturation=0.6, max_replicas=10)
+    an = CapacityAnalyzer(spec)
+    # Two replicas near-saturated -> needs ~2*0.9/0.6 = 3.
+    assert an.desired([_sample(kv_usage=0.9), _sample(kv_usage=0.9)]) == 3
+    # Queue pressure alone also saturates.
+    assert an.desired([_sample(num_waiting=16.0)]) >= 2
+
+
+def test_capacity_scale_down_and_bounds():
+    spec = VariantAutoscalingSpec(target_saturation=0.6, min_replicas=1,
+                                  max_replicas=4)
+    an = CapacityAnalyzer(spec)
+    # Mild load on 4 replicas -> shrink toward need, floor at min.
+    low = [_sample(kv_usage=0.05, num_running=1.0) for _ in range(4)]
+    assert 1 <= an.desired(low) < 4
+    # Saturation beyond max clamps.
+    hot = [_sample(kv_usage=1.0, num_waiting=50.0) for _ in range(4)]
+    assert an.desired(hot) == 4
+
+
+def test_scale_to_zero_only_when_idle_and_enabled():
+    idle = [_sample()]
+    on = CapacityAnalyzer(VariantAutoscalingSpec(scale_to_zero=True))
+    off = CapacityAnalyzer(VariantAutoscalingSpec(scale_to_zero=False))
+    assert on.desired(idle) == 0
+    assert off.desired(idle) >= 1
+
+
+def test_model_based_scales_on_slo_violation():
+    spec = VariantAutoscalingSpec(slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+    opt = ModelBasedOptimizer(spec)
+    # Mean TTFT 300ms vs 100ms SLO on 2 replicas -> 3x -> 6.
+    samples = [_sample(ttft_sum=3.0, ttft_count=10.0) for _ in range(2)]
+    assert opt.desired(samples) == 6
+    # SLOs comfortably met + empty queues -> scale down by one.
+    ok = [_sample(ttft_sum=0.2, ttft_count=10.0,
+                  itl_sum=0.02, itl_count=10.0) for _ in range(3)]
+    assert opt.desired(ok) == 2
+
+
+def test_hybrid_arbitration_takes_max():
+    spec = VariantAutoscalingSpec(mode="hybrid", slo_ttft_ms=100.0,
+                                  target_saturation=0.6, max_replicas=10)
+    wva = VariantAutoscaler(spec, endpoints=[])
+    # Capacity says 1 (idle), model says 6 (SLO 3x violated on 2 up).
+    samples = [_sample(ttft_sum=3.0, ttft_count=10.0) for _ in range(2)]
+    assert wva.decide(samples) == 6
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_actuator_metric_over_http():
+    """End-to-end: WVA scrapes two sim replicas and serves
+    inferno_desired_replicas on /metrics."""
+    from aiohttp import web
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    sim_ports = [_free_port(), _free_port()]
+    wva_port = _free_port()
+    started = []
+
+    def run(app, port):
+        ev = threading.Event()
+
+        def go():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            loop.run_until_complete(
+                web.TCPSite(runner, "127.0.0.1", port).start())
+            ev.set()
+            loop.run_forever()
+
+        threading.Thread(target=go, daemon=True).start()
+        started.append(ev)
+
+    for p in sim_ports:
+        run(build_sim_server(SimConfig(model="sim")).build_app(), p)
+    spec = VariantAutoscalingSpec(model_id="sim", mode="capacity")
+    wva = VariantAutoscaler(
+        spec, [f"127.0.0.1:{p}" for p in sim_ports],
+        reconcile_interval_s=0.1)
+    run(wva.build_app(), wva_port)
+    assert all(ev.wait(10) for ev in started)
+
+    deadline = time.time() + 10
+    text = ""
+    while time.time() < deadline:
+        r = requests.get(f"http://127.0.0.1:{wva_port}/metrics", timeout=5)
+        text = r.text
+        if "inferno_desired_replicas" in text and \
+                'inferno_current_replicas{variant_name="sim"} 2.0' in text:
+            break
+        time.sleep(0.2)
+    assert 'inferno_desired_replicas{accelerator="v5e",variant_name="sim"}' \
+        in text
+    assert 'inferno_current_replicas{variant_name="sim"} 2.0' in text

@@ -1,0 +1,242 @@
+"""Latency predictor + SLO-aware scheduling.
+
+Reference behaviors pinned (predicted-latency-based-scheduling/README.md):
+training sidecar retrains with >=100 samples (:234-244), prediction sidecars
+serve p90 TTFT/TPOT, slo-aware-profile-handler switches on the
+``x-prediction-based-scheduling`` header (:273), slo-scorer buckets by
+predicted headroom, priority<0 requests shed with no headroom (:190-192),
+and the usage frame carries actual + predicted latencies (:130-148).
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from llm_d_tpu.epp.config import parse_config
+from llm_d_tpu.epp.datastore import Datastore, EndpointState
+from llm_d_tpu.epp.plugins import (
+    RequestCtx,
+    SloAwareProfileHandler,
+    SloScorer,
+)
+from llm_d_tpu.epp.scheduler import EppScheduler
+from llm_d_tpu.predictor.model import LatencyModel, TrainingStore
+from llm_d_tpu.predictor.server import PredictionServer, TrainingServer
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+
+def test_model_learns_linear_relation_with_p90_margin():
+    rng = np.random.default_rng(0)
+    m = LatencyModel(("num_waiting", "kv_usage"))
+    X = np.column_stack([rng.uniform(0, 10, 500), rng.uniform(0, 1, 500)])
+    noise = rng.normal(0, 5, 500)
+    y = 40.0 + 30.0 * X[:, 0] + 100.0 * X[:, 1] + noise
+    m.fit(X, y)
+    pred = m.predict({"num_waiting": 5.0, "kv_usage": 0.5})
+    mean_true = 40 + 150 + 50
+    # p90 model: above the conditional mean, inside ~p99 of the noise.
+    assert mean_true < pred < mean_true + 20
+    # Round-trips through the JSON wire format.
+    m2 = LatencyModel.from_dict(m.to_dict())
+    assert abs(m2.predict({"num_waiting": 5.0, "kv_usage": 0.5}) - pred) < 1e-9
+
+
+def test_training_store_retrain_policy():
+    store = TrainingStore(min_samples=100, bucket_cap=200)
+    for i in range(99):
+        store.add("ttft", {"num_waiting": float(i % 7)}, 50.0 + i % 7)
+    assert store.retrain_if_due() == []          # below min samples
+    store.add("ttft", {"num_waiting": 1.0}, 55.0)
+    assert "ttft" in store.retrain_if_due()
+    assert store.retrain_if_due() == []          # no new data since
+
+
+# ---------------------------------------------------------------------------
+# sidecar servers over HTTP
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _serve(app, port):
+    from aiohttp import web
+    ev = threading.Event()
+
+    def go():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        loop.run_until_complete(web.TCPSite(runner, "127.0.0.1", port).start())
+        ev.set()
+        loop.run_forever()
+
+    threading.Thread(target=go, daemon=True).start()
+    assert ev.wait(10)
+
+
+def test_training_and_prediction_sidecars_roundtrip():
+    t_port, p_port = _free_port(), _free_port()
+    trainer = TrainingServer(retrain_interval_s=0.1, min_samples=100)
+    _serve(trainer.build_app(), t_port)
+    _serve(PredictionServer(f"http://127.0.0.1:{t_port}",
+                            sync_interval_s=0.1).build_app(), p_port)
+
+    # Feed 200 samples with a clear queue-depth signal.
+    samples = [{"target": "ttft",
+                "features": {"num_waiting": float(i % 10), "num_running": 1.0,
+                             "kv_usage": 0.1, "prompt_tokens": 64.0},
+                "actual_ms": 20.0 + 30.0 * (i % 10)} for i in range(200)]
+    r = requests.post(f"http://127.0.0.1:{t_port}/samples", json=samples,
+                      timeout=5)
+    assert r.json()["accepted"] == 200
+
+    deadline = time.time() + 10
+    pred = {}
+    while time.time() < deadline:
+        r = requests.post(
+            f"http://127.0.0.1:{p_port}/predict",
+            json={"features": {"num_waiting": 8.0, "num_running": 1.0,
+                               "kv_usage": 0.1, "prompt_tokens": 64.0}},
+            timeout=5)
+        pred = r.json()
+        if pred.get("ttft_ms", 0.0) > 0.0:
+            break
+        time.sleep(0.2)
+    # 20 + 30*8 = 260 mean; p90 adds a little.
+    assert 200.0 < pred["ttft_ms"] < 350.0
+    assert requests.get(f"http://127.0.0.1:{p_port}/readyz",
+                        timeout=5).status_code == 200
+
+
+# ---------------------------------------------------------------------------
+# SLO plugins
+# ---------------------------------------------------------------------------
+
+
+def _endpoint(addr, waiting=0.0, kv=0.0):
+    e = EndpointState(address=addr)
+    e.ready = True
+    e.num_waiting = waiting
+    e.kv_usage = kv
+    return e
+
+
+SLO_CONFIG = """
+apiVersion: inference.networking.x-k8s.io/v1alpha1
+kind: EndpointPickerConfig
+plugins:
+- type: queue-scorer
+- type: slo-request-tracker
+- type: slo-scorer
+- type: slo-aware-profile-handler
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: queue-scorer
+  - pluginRef: max-score-picker
+- name: slo
+  plugins:
+  - pluginRef: slo-request-tracker
+  - pluginRef: slo-scorer
+  - pluginRef: max-score-picker
+"""
+
+
+def _scheduler(endpoints):
+    ds = Datastore(endpoints, scrape_interval_s=1000)
+    return EppScheduler(parse_config(SLO_CONFIG), ds)
+
+
+def test_slo_profile_handler_switches_on_header():
+    h = SloAwareProfileHandler("h", {}, None)
+    ctx = RequestCtx(body={}, in_headers={})
+    assert h.profiles(ctx, ["default", "slo"]) == ["default"]
+    ctx = RequestCtx(body={}, in_headers={
+        "x-prediction-based-scheduling": "true"})
+    assert h.profiles(ctx, ["default", "slo"]) == ["slo"]
+
+
+def test_slo_scorer_prefers_endpoint_with_headroom():
+    sched = _scheduler([_endpoint("idle:1"),
+                        _endpoint("busy:1", waiting=20.0, kv=0.9)])
+    ctx = RequestCtx(body={}, prompt_text="x" * 100, in_headers={
+        "x-prediction-based-scheduling": "true",
+        "x-slo-ttft-ms": "500", "x-slo-tpot-ms": "50"})
+    result = sched.schedule(ctx)
+    assert result.primary.address == "idle:1"
+    assert ctx.predictions["ttft_ms"] > 0
+
+
+def test_shed_when_no_headroom_and_negative_priority():
+    # Every endpoint deeply saturated; SLOs unmeetable.
+    sched = _scheduler([_endpoint("b1:1", waiting=50.0, kv=0.95),
+                        _endpoint("b2:1", waiting=60.0, kv=0.95)])
+    ctx = RequestCtx(body={}, prompt_text="x", priority=-1, in_headers={
+        "x-prediction-based-scheduling": "true",
+        "x-slo-ttft-ms": "1", "x-slo-tpot-ms": "1"})
+    sched.schedule(ctx)
+    assert ctx.shed
+    # Same request at priority 0 is NOT shed (queued in negative bucket).
+    ctx2 = RequestCtx(body={}, prompt_text="x", priority=0, in_headers={
+        "x-prediction-based-scheduling": "true",
+        "x-slo-ttft-ms": "1", "x-slo-tpot-ms": "1"})
+    r2 = sched.schedule(ctx2)
+    assert not ctx2.shed and r2.primary is not None
+
+
+def test_slo_scorer_no_slo_headers_picks_lowest_latency():
+    scorer = SloScorer("s", {}, None)
+    cands = [_endpoint("fast:1"), _endpoint("slow:1", waiting=30.0)]
+    ctx = RequestCtx(body={}, in_headers={})
+    scores = scorer.score(ctx, cands)
+    # SLO=0 => everything negative bucket; least-deficit (fast) wins.
+    assert scores["fast:1"] > scores["slow:1"]
+
+
+# ---------------------------------------------------------------------------
+# usage frame actuals (model server side)
+# ---------------------------------------------------------------------------
+
+
+def test_usage_frame_reports_latency_actuals_and_predictions():
+    from llm_d_tpu.engine.engine import EngineConfig
+    from llm_d_tpu.server.openai import build_server
+
+    port = _free_port()
+    server = build_server(EngineConfig(
+        model="tiny", block_size=4, num_blocks=64, max_num_seqs=8,
+        max_num_batched_tokens=64, min_token_bucket=16, min_seq_bucket=4))
+    _serve(server.build_app(), port)
+    url = f"http://127.0.0.1:{port}"
+    for _ in range(100):
+        try:
+            if requests.get(url + "/v1/models", timeout=5).status_code == 200:
+                break
+        except requests.ConnectionError:
+            pass
+        time.sleep(0.1)
+    r = requests.post(url + "/v1/completions", json={
+        "prompt": [1, 2, 3, 4], "max_tokens": 4, "temperature": 0,
+        "ignore_eos": True,
+        "_predicted": {"ttft_ms": 123.0, "tpot_ms": 4.5}}, timeout=120)
+    usage = r.json()["usage"]
+    assert usage["ttft_ms"] > 0
+    assert usage["avg_tpot_ms"] > 0
+    assert usage["predicted_ttft_ms"] == 123.0
+    assert usage["avg_predicted_tpot_ms"] == 4.5
