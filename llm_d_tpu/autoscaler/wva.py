@@ -72,11 +72,17 @@ class ReplicaSample:
 
 
 class Collector:
-    """Scrapes every replica's /metrics into ReplicaSamples."""
+    """Scrapes every replica's /metrics into ReplicaSamples.
+
+    Histogram sums/counts are CUMULATIVE in the exposition format; scaling
+    decisions must track the current window, so successive scrapes are
+    diffed per endpoint (the in-process analogue of the reference's
+    Prometheus ``rate()`` queries)."""
 
     def __init__(self, endpoints: List[str]) -> None:
         self.endpoints = endpoints
         self._session: Optional[aiohttp.ClientSession] = None
+        self._prev: Dict[str, Dict[str, float]] = {}
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
@@ -104,10 +110,18 @@ class Collector:
         s.num_waiting = m.get("vllm:num_requests_waiting", 0.0)
         s.num_running = m.get("vllm:num_requests_running", 0.0)
         s.generation_tokens_total = m.get("vllm:generation_tokens_total", 0.0)
-        s.ttft_sum = m.get("vllm:time_to_first_token_seconds_sum", 0.0)
-        s.ttft_count = m.get("vllm:time_to_first_token_seconds_count", 0.0)
-        s.itl_sum = m.get("vllm:inter_token_latency_seconds_sum", 0.0)
-        s.itl_count = m.get("vllm:inter_token_latency_seconds_count", 0.0)
+        raw = {
+            "ttft_sum": m.get("vllm:time_to_first_token_seconds_sum", 0.0),
+            "ttft_count": m.get("vllm:time_to_first_token_seconds_count", 0.0),
+            "itl_sum": m.get("vllm:inter_token_latency_seconds_sum", 0.0),
+            "itl_count": m.get("vllm:inter_token_latency_seconds_count", 0.0),
+        }
+        prev = self._prev.get(endpoint, {})
+        for key, val in raw.items():
+            delta = val - prev.get(key, 0.0)
+            # Counter reset (process restart): fall back to the raw value.
+            setattr(s, key, delta if delta >= 0 else val)
+        self._prev[endpoint] = raw
         return s
 
 
@@ -127,6 +141,12 @@ class CapacityAnalyzer:
         up = [s for s in samples if s.ready]
         current = max(len(up), 1)
         if not up:
+            # A scaled-to-zero fleet must STAY at zero (no replicas is the
+            # steady state we asked for, not an outage) — scale-up from
+            # zero needs a demand signal (gateway queue / HPA request
+            # metric), not this loop, or it flaps 0<->1 forever.
+            if spec.scale_to_zero and spec.min_replicas == 0:
+                return 0
             return max(spec.min_replicas, 1)
         sat = [max(s.kv_usage, min(1.0, s.num_waiting / self.queue_norm))
                for s in up]
@@ -154,6 +174,8 @@ class ModelBasedOptimizer:
         spec = self.spec
         up = [s for s in samples if s.ready]
         if not up:
+            if spec.scale_to_zero and spec.min_replicas == 0:
+                return 0        # see CapacityAnalyzer: no 0<->1 flapping
             return max(spec.min_replicas, 1)
         current = len(up)
         ttft_ms = _mean_ms(sum(s.ttft_sum for s in up),

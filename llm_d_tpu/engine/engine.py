@@ -166,13 +166,16 @@ class EngineCore:
             self.host_tier = HostKVTier(self, config.kv_offload_blocks)
 
         self._step_fn = self._build_step_fn()
+        # Variant computing top-N logprobs, compiled on first use (steps
+        # with no logprobs request never pay the extra top_k).
+        self._step_fn_top = None
         self._multistep_fn = (
             self._build_multistep_fn(config.num_scheduler_steps)
             if config.num_scheduler_steps > 1 else None)
 
     # ---------- jitted step ----------
 
-    def _build_step_fn(self):
+    def _build_step_fn(self, want_top_logprobs: bool = False):
         c = self.model_config
         block_size = self.config.block_size
         backend = self.config.attn_backend
@@ -194,8 +197,14 @@ class EngineCore:
             ids = sampling_ops.sample(
                 logits, batch["temperature"], batch["top_k"], batch["top_p"],
                 rng, seeds=batch["seeds"], gen_idx=batch["gen_idx"])
-            logprobs = sampling_ops.compute_logprobs(logits, ids)
-            return ids, logprobs, kv_cache, routed
+            if want_top_logprobs:
+                logprobs, top_ids, top_lps = \
+                    sampling_ops.compute_top_logprobs(logits, ids)
+                top = (top_ids, top_lps)
+            else:
+                logprobs = sampling_ops.compute_logprobs(logits, ids)
+                top = None
+            return ids, logprobs, kv_cache, routed, top
 
         return step_fn
 
@@ -275,13 +284,18 @@ class EngineCore:
             if req.num_tokens + K >= self.model_config.max_model_len:
                 return None
         # Pre-allocate blocks to cover K new tokens for every request.
-        allocated = []
+        allocated: List[Tuple[Request, List[int]]] = []
         for sr in sched.scheduled:
             req = sr.request
             ok = self.kv_manager.allocate(req, req.num_computed_tokens + K)
             if ok is None:
-                return None   # fall back to single-step (blocks stay; freed on finish)
-            allocated.append(ok)
+                # Roll back earlier requests' speculative tail blocks —
+                # holding them until finish is a fragmentation source under
+                # exactly the memory pressure that made allocation fail.
+                for r, blocks in reversed(allocated):
+                    self.kv_manager.release_tail(r, blocks)
+                return None   # fall back to single-step
+            allocated.append((req, ok))
         return K
 
     def _run_multistep(self, sched: SchedulerOutput, K: int) -> List[RequestOutput]:
@@ -523,10 +537,18 @@ class EngineCore:
 
         batch, scheduled = self._build_batch(sched)
         self._rng, step_key = jax.random.split(self._rng)
-        ids, logprobs, self.kv_cache, routed = self._step_fn(
+        want_top = any(sr.request.sampling.logprobs
+                       for sr in sched.scheduled)
+        if want_top and self._step_fn_top is None:
+            self._step_fn_top = self._build_step_fn(want_top_logprobs=True)
+        fn = self._step_fn_top if want_top else self._step_fn
+        ids, logprobs, self.kv_cache, routed, top = fn(
             self.params, self.kv_cache, batch, step_key)
         ids = np.asarray(jax.device_get(ids))
         logprobs = np.asarray(jax.device_get(logprobs))
+        if top is not None:
+            top = (np.asarray(jax.device_get(top[0])),
+                   np.asarray(jax.device_get(top[1])))
         self._step_count += 1
         if self.eplb is not None:
             # Record routed logical ids (sampled; padding rows excluded so
@@ -569,10 +591,16 @@ class EngineCore:
             req.output_token_ids.append(token)
             self.metrics.generation_tokens.inc()
             finish = self._check_stop(req, token)
+            top_lp = None
+            if req.sampling.logprobs and top is not None:
+                n = min(int(req.sampling.logprobs) or 1, top[0].shape[1])
+                top_lp = [{int(top[0][s, j]): float(top[1][s, j])
+                           for j in range(n)}]
             out = RequestOutput(
                 req.request_id, [token], finish is not None,
                 finish_reason=finish,
-                logprobs=[float(logprobs[s])] if req.sampling.logprobs else None)
+                logprobs=[float(logprobs[s])] if req.sampling.logprobs else None,
+                top_logprobs=top_lp)
             outputs.append(out)
             if finish is not None:
                 self.scheduler.finish(req, RequestState(finish))

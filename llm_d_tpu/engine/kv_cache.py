@@ -115,19 +115,26 @@ class KVCacheManager:
     # ---------- allocation ----------
 
     def _take_free_block(self) -> Optional[int]:
+        return self.take_block()
+
+    def take_block(self, protected: frozenset = frozenset()) -> Optional[int]:
+        """Claim a block: plain free first, else evict the LRU cached block
+        not in ``protected`` (the offload tier protects the prefix chain it
+        is mid-way through assembling)."""
         while self._free:
             b = self._free.popleft()
             if b not in self._evictor:      # plain free block
                 return b
-        if self._evictor:                   # evict LRU cached block
-            b, _ = self._evictor.popitem(last=False)
-            h = self._hash_of.pop(b, None)
-            if h is not None and self._cached.get(h) == b:
+        victim = next((b for b in self._evictor if b not in protected), None)
+        if victim is not None:              # evict LRU cached block
+            del self._evictor[victim]
+            h = self._hash_of.pop(victim, None)
+            if h is not None and self._cached.get(h) == victim:
                 del self._cached[h]
                 self.eviction_count += 1
                 for cb in self.on_block_removed:
-                    cb(h, b)
-            return b
+                    cb(h, victim)
+            return victim
         return None
 
     def can_allocate(self, n: int) -> bool:
@@ -183,6 +190,15 @@ class KVCacheManager:
             self._release(b)
         request.block_ids = []
         self._req_hashes.pop(request.request_id, None)
+
+    def release_tail(self, request: Request, blocks: Sequence[int]) -> None:
+        """Give back just-attached tail blocks (speculative over-allocation
+        rollback: the multistep fast path pre-allocates K tokens of blocks
+        and must not hold them when it falls back to single-step)."""
+        for b in reversed(blocks):
+            assert request.block_ids and request.block_ids[-1] == b
+            request.block_ids.pop()
+            self._release(b)
 
     def uncache_block(self, block_id: int) -> None:
         """Drop a block's cache entry (used by offload tier on invalidation)."""

@@ -105,27 +105,9 @@ class HostKVTier:
             return None
         e = self.engine
         km = e.kv_manager
-        b = None
-        while km._free:                      # plain free block first
-            cand = km._free.popleft()
-            if cand not in km._evictor:
-                b = cand
-                break
+        b = km.take_block(protected)
         if b is None:
-            # Evict the LRU cached block that is not part of this chain
-            # (its KV stays restorable from this tier).
-            victim = next((v for v in km._evictor if v not in protected),
-                          None)
-            if victim is None:
-                return None      # everything free is protected; recompute
-            del km._evictor[victim]
-            h_old = km._hash_of.pop(victim, None)
-            if h_old is not None and km._cached.get(h_old) == victim:
-                del km._cached[h_old]
-                km.eviction_count += 1
-                for cb in km.on_block_removed:
-                    cb(h_old, victim)
-            b = victim
+            return None          # everything free is protected; recompute
         bs = e.config.block_size
         k_new, v_new = _scatter_fn(1, bs)(
             e.kv_cache["k"], e.kv_cache["v"],

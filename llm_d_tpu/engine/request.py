@@ -75,3 +75,6 @@ class RequestOutput:
     finish_reason: Optional[str] = None
     kv_transfer_params: Optional[Dict[str, Any]] = None
     logprobs: Optional[List[float]] = None
+    # Per new token: {token_id: logprob} of the top-N alternatives
+    # (the OpenAI ``logprobs`` field's data; weak #8 in round-2 review).
+    top_logprobs: Optional[List[Dict[int, float]]] = None

@@ -99,3 +99,17 @@ def compute_logprobs(logits: jax.Array, token_ids: jax.Array) -> jax.Array:
     """Log-probability of the chosen tokens. logits [S, V], ids [S]."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
+
+
+def compute_top_logprobs(logits: jax.Array, token_ids: jax.Array,
+                         n: int = 20):   # OpenAI chat's top_logprobs max
+    """Chosen-token logprobs plus the top-``n`` alternatives.
+
+    Returns (chosen [S], top_ids [S, n], top_logprobs [S, n]) — the data
+    the OpenAI ``logprobs`` response field needs (vLLM returns the same
+    per-position top list).  ``n`` is static: one extra ``lax.top_k`` over
+    the already-materialized log-softmax."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    chosen = jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(logp, n)
+    return chosen, top_ids.astype(jnp.int32), top_lps

@@ -282,8 +282,15 @@ class ModelServer:
             return resp
 
         final_out = None
+        lp_ids: List[int] = []
+        lp_vals: List[float] = []
+        lp_tops: List[Dict[int, float]] = []
         async for out in self.async_engine.generate(req):
             final_out = out
+            if req.sampling.logprobs:
+                lp_ids.extend(out.new_token_ids)
+                lp_vals.extend(out.logprobs or [])
+                lp_tops.extend(out.top_logprobs or [])
         text = self.tokenizer.decode(req.output_token_ids)
         text, stopped = self._apply_stop_strings(req, text, text)
         finish_reason = final_out.finish_reason if final_out else None
@@ -302,6 +309,24 @@ class ModelServer:
             }],
             "usage": self._usage(req, body),
         }
+        if req.sampling.logprobs and lp_ids:
+            # OpenAI completions logprobs block: per-token chosen logprob
+            # plus the top-N alternatives (weak #8: round 2 only returned
+            # the chosen token's value).
+            toks = [self.tokenizer.decode([t]) for t in lp_ids]
+            offsets, pos = [], 0
+            for t in toks:
+                offsets.append(pos)
+                pos += len(t)
+            payload["choices"][0]["logprobs"] = {
+                "tokens": toks,
+                "token_logprobs": lp_vals,
+                "top_logprobs": [
+                    {self.tokenizer.decode([tid]): lp
+                     for tid, lp in top.items()}
+                    for top in lp_tops] if lp_tops else None,
+                "text_offset": offsets,
+            }
         if final_out is not None and final_out.kv_transfer_params:
             payload["kv_transfer_params"] = final_out.kv_transfer_params
         self._post_training_sample(req, arrival_feats)
@@ -347,6 +372,16 @@ def build_server(engine_config: EngineConfig, tokenizer_name: Optional[str] = No
 
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser("llmd-serve")
+    p.add_argument("--config", default=None,
+                   help="YAML config file (keys = these flags); layered "
+                        "with --config-overlay, CLI flags win "
+                        "(reference: helmfile env -> values -> hw overlay)")
+    p.add_argument("--config-overlay", action="append", default=[],
+                   help="additional overlay YAML(s), later wins")
+    p.add_argument("--compilation-cache-dir", default=None,
+                   help="persistent XLA compile cache surviving restarts "
+                        "(reference: VLLM_CACHE_ROOT mounts, "
+                        "decode.yaml:152-164)")
     p.add_argument("--model", default="tiny")
     p.add_argument("--tokenizer", default=None)
     p.add_argument("--host", default="0.0.0.0")
@@ -395,6 +430,15 @@ def main(argv: Optional[List[str]] = None) -> None:
              "defaults to <host>:<port>")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)   # before any startup logs
+    if args.config or args.config_overlay:
+        from llm_d_tpu.utils.config import apply_file_config, load_layers
+        layers = ([args.config] if args.config else []) + args.config_overlay
+        apply_file_config(args, p, load_layers(layers))
+    if args.compilation_cache_dir:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          args.compilation_cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     from llm_d_tpu.parallel.mesh import MeshConfig, maybe_init_distributed
     # Multi-host TPU slice: join the process group before touching devices

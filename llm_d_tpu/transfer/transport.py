@@ -41,8 +41,15 @@ def _load_native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_failed:
             return _lib
         try:
+            # A wheel may ship only the prebuilt .so (no toolchain in the
+            # runtime image); rebuild solely when the source is present
+            # and newer.
             if (not os.path.exists(_LIB_PATH)
-                    or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+                    or (os.path.exists(_SRC)
+                        and os.path.getmtime(_LIB_PATH)
+                        < os.path.getmtime(_SRC))):
+                if not os.path.exists(_SRC):
+                    raise OSError(f"{_SRC} missing and no prebuilt library")
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                      "-pthread", "-o", _LIB_PATH + ".tmp", _SRC],

@@ -1,0 +1,60 @@
+"""Layered configuration: base file -> hardware overlays -> CLI flags.
+
+The reference layers helmfile environments over shared
+``common-configurations/*.yaml`` over per-guide values over hardware
+overlays (``values_tpu.yaml`` etc.) over kustomize patches (reference:
+SURVEY.md §5 config system; modelservice.md:21,47 formalizes preset-values
+vs model-values layering).  The TPU stack's equivalent for a single
+process: deep-merged YAML layers with later layers winning, then explicit
+CLI flags on top.
+
+    llmd-serve --config base.yaml --config-overlay tpu-v5e.yaml --port 9000
+
+Merge semantics: dicts merge recursively; scalars and lists replace.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence
+
+import yaml
+
+
+def deep_merge(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive merge; overlay wins, dicts merge, everything else replaces."""
+    out = copy.deepcopy(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def load_layers(paths: Sequence[str]) -> Dict[str, Any]:
+    """Load + merge YAML config layers in order (later wins)."""
+    merged: Dict[str, Any] = {}
+    for path in paths:
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        if not isinstance(doc, dict):
+            raise ValueError(f"{path}: config layer must be a mapping")
+        merged = deep_merge(merged, doc)
+    return merged
+
+
+def apply_file_config(args, parser, merged: Dict[str, Any]) -> None:
+    """Overlay file config onto argparse results, CLI flags still winning.
+
+    A file key ``max-num-seqs`` (or ``max_num_seqs``) maps to the argparse
+    dest; only values the user did NOT set explicitly on the CLI are
+    replaced (detected via a second parse against empty argv defaults)."""
+    defaults = {a.dest: a.default for a in parser._actions}
+    for key, value in merged.items():
+        dest = key.replace("-", "_")
+        if dest not in defaults:
+            raise ValueError(f"unknown config key {key!r}")
+        # CLI wins: only apply when the arg still holds its default.
+        if getattr(args, dest) == defaults[dest]:
+            setattr(args, dest, value)
