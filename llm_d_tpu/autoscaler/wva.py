@@ -136,16 +136,17 @@ class CapacityAnalyzer:
         self.spec = spec
         self.queue_norm = queue_norm    # waiting requests ~ "fully busy"
 
-    def desired(self, samples: List[ReplicaSample]) -> int:
+    def desired(self, samples: List[ReplicaSample],
+                was_at_zero: bool = False) -> int:
         spec = self.spec
         up = [s for s in samples if s.ready]
         current = max(len(up), 1)
         if not up:
-            # A scaled-to-zero fleet must STAY at zero (no replicas is the
-            # steady state we asked for, not an outage) — scale-up from
-            # zero needs a demand signal (gateway queue / HPA request
-            # metric), not this loop, or it flaps 0<->1 forever.
-            if spec.scale_to_zero and spec.min_replicas == 0:
+            # Distinguish "we scaled to zero deliberately" (stay there;
+            # scale-up from zero needs a demand signal, not this loop, or
+            # it flaps 0<->1 forever) from "replicas exist but are all
+            # unready" (an outage/restart — keep asking for capacity).
+            if was_at_zero and spec.scale_to_zero and spec.min_replicas == 0:
                 return 0
             return max(spec.min_replicas, 1)
         sat = [max(s.kv_usage, min(1.0, s.num_waiting / self.queue_norm))
@@ -170,12 +171,14 @@ class ModelBasedOptimizer:
     def __init__(self, spec: VariantAutoscalingSpec) -> None:
         self.spec = spec
 
-    def desired(self, samples: List[ReplicaSample]) -> int:
+    def desired(self, samples: List[ReplicaSample],
+                was_at_zero: bool = False) -> int:
         spec = self.spec
         up = [s for s in samples if s.ready]
         if not up:
-            if spec.scale_to_zero and spec.min_replicas == 0:
-                return 0        # see CapacityAnalyzer: no 0<->1 flapping
+            # see CapacityAnalyzer: deliberate zero stays, outages don't.
+            if was_at_zero and spec.scale_to_zero and spec.min_replicas == 0:
+                return 0
             return max(spec.min_replicas, 1)
         current = len(up)
         ttft_ms = _mean_ms(sum(s.ttft_sum for s in up),
@@ -226,13 +229,15 @@ class VariantAutoscaler:
 
     def decide(self, samples: List[ReplicaSample]) -> int:
         mode = self.spec.mode
-        cap = self.capacity.desired(samples)
+        at_zero = self.desired_replicas == 0
+        cap = self.capacity.desired(samples, was_at_zero=at_zero)
         if mode == "capacity":
             desired = cap
         elif mode == "model-only":
-            desired = self.model.desired(samples)
+            desired = self.model.desired(samples, was_at_zero=at_zero)
         else:                       # hybrid: arbitrate (take the max)
-            desired = max(cap, self.model.desired(samples))
+            desired = max(cap, self.model.desired(samples,
+                                                  was_at_zero=at_zero))
         return desired
 
     async def reconcile_once(self) -> int:

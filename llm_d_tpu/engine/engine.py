@@ -126,15 +126,19 @@ class EngineCore:
             self.params = self.eplb.install(self.params, self.mesh, rules)
 
         num_slots = config.num_blocks * config.block_size
-        # Folded layout [L, slots, KVH*D]: 128-lane-aligned page DMAs and
-        # contiguous scatter rows (see ops/attention.py docstring).
-        kv_shape = (c.num_layers, num_slots, c.num_kv_heads * c.head_dim_)
+        # Folded layout [L, slots, row_width]: 128-lane-aligned page DMAs
+        # and contiguous scatter rows (see ops/attention.py docstring).
+        # Buffer names/widths come from the model: dense models carry
+        # {k, v} of KVH*D each; MLA models ONE latent buffer (models/mla).
+        layout = self.model.kv_cache_layout(c)
         kv_sharding = {
-            k: NamedSharding(self.mesh, spec)
-            for k, spec in self.model.kv_cache_spec().items()}
+            name: NamedSharding(self.mesh, spec)
+            for name, spec in self.model.kv_cache_spec(c).items()}
         self.kv_cache = {
-            k: jax.device_put(jnp.zeros(kv_shape, jnp.bfloat16), kv_sharding[k])
-            for k in ("k", "v")}
+            name: jax.device_put(
+                jnp.zeros((c.num_layers, num_slots, width), jnp.bfloat16),
+                kv_sharding[name])
+            for name, width in layout.items()}
         self._replicated = NamedSharding(self.mesh, P())
 
         self.max_blocks_per_seq = -(-c.max_model_len // config.block_size)

@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from llm_d_tpu.transfer.connector import _gather_fn, _scatter_fn
+from llm_d_tpu.transfer.connector import _cache_items, _gather_fn, _scatter_fn
 
 logger = logging.getLogger(__name__)
 
@@ -76,13 +76,17 @@ class HostKVTier:
             nb_pad *= 2
         ids = np.zeros(nb_pad, np.int32)
         ids[:nb] = [b for _, b in pending]
-        slab = _gather_fn(nb_pad, bs)(e.kv_cache["k"], e.kv_cache["v"],
-                                      jax.numpy.asarray(ids))
-        host = np.asarray(jax.device_get(slab))          # [2, L, nb_pad*bs, F]
-        L, F = host.shape[1], host.shape[3]
-        host = host.reshape(2, L, nb_pad, bs, F)
+        ids_dev = jax.numpy.asarray(ids)
+        # One gather + device_get per cache buffer ({k, v} dense, {kv} MLA).
+        hosts = {}
+        for name, buf in _cache_items(e):
+            slab = _gather_fn(nb_pad, bs)(buf, ids_dev)
+            L, _, W = slab.shape
+            hosts[name] = np.asarray(
+                jax.device_get(slab)).reshape(L, nb_pad, bs, W)
         for i, (h, _) in enumerate(pending):
-            self._store[h] = np.ascontiguousarray(host[:, :, i])
+            self._store[h] = {name: np.ascontiguousarray(arr[:, i])
+                              for name, arr in hosts.items()}
             self.saves += 1
             e.metrics.kv_offload_saves.inc()
         while len(self._store) > self.capacity_blocks:
@@ -109,11 +113,10 @@ class HostKVTier:
         if b is None:
             return None          # everything free is protected; recompute
         bs = e.config.block_size
-        k_new, v_new = _scatter_fn(1, bs)(
-            e.kv_cache["k"], e.kv_cache["v"],
-            jax.numpy.asarray(np.asarray([b], np.int32)),
-            jax.numpy.asarray(slab))
-        e.kv_cache["k"], e.kv_cache["v"] = k_new, v_new
+        ids_dev = jax.numpy.asarray(np.asarray([b], np.int32))
+        for name, arr in slab.items():
+            e.kv_cache[name] = _scatter_fn(1, bs)(
+                e.kv_cache[name], ids_dev, jax.numpy.asarray(arr))
         self._store.move_to_end(block_hash)
         km._hash_of[b] = block_hash
         km._cached[block_hash] = b

@@ -46,6 +46,19 @@ class ModelConfig:
     # "softmax" (Mixtral/Qwen-MoE) or "sigmoid" (DeepSeek-V3/R1: sigmoid
     # scores + e_score_correction_bias used for selection only).
     scoring_func: str = "softmax"
+    # --- MLA (multi-head latent attention; 0 = classic MHA/GQA) ---
+    # DeepSeek-V3/R1 compress KV into a rank-512 latent + one shared 64-d
+    # RoPE key per token: the serving cache holds 576 values/token instead
+    # of num_heads * head_dim * 2 (the reason wide-EP decode fits).
+    q_lora_rank: int = 0
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+
+    @property
+    def use_mla(self) -> bool:
+        return self.kv_lora_rank > 0
 
     def __post_init__(self):
         if self.scoring_func not in ("softmax", "sigmoid"):
@@ -104,15 +117,27 @@ PRESETS = {
         intermediate_size=16384, num_layers=56, num_heads=48, num_kv_heads=8,
         rope_theta=1000000.0, max_model_len=32000,
         num_experts=8, num_experts_per_tok=2, moe_intermediate_size=16384),
-    # DeepSeek-V3/R1-class MoE (MHA dims simplified: GQA stand-in for MLA,
-    # MLA-proper is tracked as a follow-up kernel).
+    # DeepSeek-V3/R1-class MoE with MLA-proper: the KV cache holds the
+    # rank-512 latent + shared 64-d RoPE key (576/token vs 32768 for the
+    # round-3 GQA stand-in — the memory profile wide-EP decode relies on).
     "deepseek-v3": ModelConfig(
         name="deepseek-v3", vocab_size=129280, hidden_size=7168,
-        intermediate_size=18432, num_layers=61, num_heads=128, num_kv_heads=128,
+        intermediate_size=18432, num_layers=61, num_heads=128, num_kv_heads=1,
         head_dim=128, rope_theta=10000.0, max_model_len=32000,
         num_experts=256, num_experts_per_tok=8, moe_intermediate_size=2048,
         num_shared_experts=1, first_dense_layers=3, n_group=8, topk_group=4,
-        routed_scaling_factor=2.5, scoring_func="sigmoid"),
+        routed_scaling_factor=2.5, scoring_func="sigmoid",
+        q_lora_rank=1536, kv_lora_rank=512, qk_nope_head_dim=128,
+        qk_rope_head_dim=64, v_head_dim=128),
+    # Tiny MLA+MoE config for CPU tests.
+    "tiny-mla": ModelConfig(
+        name="tiny-mla", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=1,
+        rope_theta=10000.0, max_model_len=512, num_experts=8,
+        num_experts_per_tok=2, moe_intermediate_size=96,
+        num_shared_experts=1, first_dense_layers=1,
+        q_lora_rank=32, kv_lora_rank=32, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16),
 }
 
 

@@ -170,7 +170,13 @@ def sharding_rules(config: ModelConfig):
     ]
 
 
-def kv_cache_spec() -> Dict[str, P]:
+def kv_cache_layout(config: ModelConfig) -> Dict[str, int]:
+    """Per-buffer cache row widths (folded [KVH*D] layout)."""
+    w = config.num_kv_heads * config.head_dim_
+    return {"k": w, "v": w}
+
+
+def kv_cache_spec(config: ModelConfig = None) -> Dict[str, P]:
     """KV cache sharding: folded head dim over tp (per-head D-blocks stay
     contiguous when tp divides num_kv_heads), slots replicated."""
     return {"k": P(None, None, "tp"), "v": P(None, None, "tp")}

@@ -101,6 +101,20 @@ _ATTN_KEYS = ("input_norm", "q_proj", "k_proj", "v_proj", "o_proj",
               "post_attn_norm")
 _MLP_KEYS = ("gate_proj", "up_proj", "down_proj")
 
+# MLA projections (DeepSeek-V3/R1 HF naming; models/mla.py layout).
+_MLA_MAP = {
+    "input_norm": "input_layernorm.weight",
+    "post_attn_norm": "post_attention_layernorm.weight",
+    "q_a_proj": "self_attn.q_a_proj.weight",
+    "q_a_norm": "self_attn.q_a_layernorm.weight",
+    "q_b_proj": "self_attn.q_b_proj.weight",
+    "kv_a_proj": "self_attn.kv_a_proj_with_mqa.weight",
+    "kv_a_norm": "self_attn.kv_a_layernorm.weight",
+    "kv_b_proj": "self_attn.kv_b_proj.weight",
+    "o_proj": "self_attn.o_proj.weight",
+}
+_MLA_TRANSPOSE = {"q_a_proj", "q_b_proj", "kv_a_proj", "kv_b_proj", "o_proj"}
+
 
 def load_moe_from_state_dict(
     config: ModelConfig,
@@ -135,6 +149,18 @@ def load_moe_from_state_dict(
     }
 
     def fill_attn(group: Dict, layer_ids):
+        if c.use_mla:
+            mla_map = dict(_MLA_MAP)
+            if c.q_lora_rank == 0:
+                # DeepSeek-V2-Lite: no query low-rank path, plain q_proj.
+                for k_ in ("q_a_proj", "q_a_norm", "q_b_proj"):
+                    mla_map.pop(k_)
+                mla_map["q_proj"] = "self_attn.q_proj.weight"
+            for ours, hf_suffix in mla_map.items():
+                group[ours] = stack(
+                    [f"{prefix}layers.{li}.{hf_suffix}" for li in layer_ids],
+                    ours in _MLA_TRANSPOSE or ours == "q_proj")
+            return
         for ours in _ATTN_KEYS:
             hf_suffix = _LAYER_MAP[ours]
             if f"{prefix}layers.{layer_ids[0]}.{hf_suffix}" not in weights:
@@ -263,4 +289,10 @@ def config_from_hf_dir(path: str, name: str = "hf") -> ModelConfig:
         topk_group=int(hf.get("topk_group") or 0),
         routed_scaling_factor=float(hf.get("routed_scaling_factor", 1.0)),
         scoring_func=hf.get("scoring_func", "softmax"),
+        # MLA (DeepSeek-V2/V3): present iff kv_lora_rank is configured.
+        q_lora_rank=int(hf.get("q_lora_rank") or 0),
+        kv_lora_rank=int(hf.get("kv_lora_rank") or 0),
+        qk_nope_head_dim=int(hf.get("qk_nope_head_dim") or 0),
+        qk_rope_head_dim=int(hf.get("qk_rope_head_dim") or 0),
+        v_head_dim=int(hf.get("v_head_dim") or 0),
     )

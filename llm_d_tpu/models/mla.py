@@ -1,0 +1,156 @@
+"""Multi-head latent attention (MLA), the DeepSeek-V3/R1 attention.
+
+What the reference serves on GPUs through vLLM's MLA kernels, TPU-first:
+
+  - KV compression: each token caches only ``c_kv`` (rank ``kv_lora_rank``
+    latent) and one shared RoPE key ``k_pe`` (``qk_rope_head_dim``) —
+    576 values/token for V3 vs num_heads*head_dim*2 = 32768 materialized.
+    This is the memory profile that lets wide-EP decode hold large batches
+    (reference deploys DeepSeek-R1 with exactly this cache layout).
+  - Weight absorption (the serving formulation): queries absorb W_uk so
+    scores are a single dot against the cached row,
+        score(t, s, h) = [q_nope_t,h @ W_uk_h | q_pe_t,h] . [c_kv_s | k_pe_s]
+    and outputs absorb W_uv after attending over ``c_kv`` directly.  The
+    whole thing maps onto the engine's ragged paged attention with
+    KVH=1, D = kv_lora_rank + qk_rope_head_dim, v-cache aliased to the
+    k-cache (values are the first kv_lora_rank columns of the key row).
+  - One paged buffer ("kv") instead of k+v: the engine builds caches from
+    ``kv_cache_layout`` so MLA models literally allocate half the buffers.
+
+RoPE here is the base rotary scheme (YaRN long-context scaling is a
+config-level extension, tracked separately).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from llm_d_tpu.models.config import ModelConfig
+from llm_d_tpu.ops import attention as A
+from llm_d_tpu.ops import layers as L
+
+Params = Dict[str, Any]
+
+
+def mla_param_shapes(c: ModelConfig, n_layers: int) -> Dict[str, Tuple[int, ...]]:
+    """Stacked-per-layer MLA projection shapes (HF DeepSeek naming).
+
+    ``q_lora_rank == 0`` (DeepSeek-V2-Lite) has no query low-rank path:
+    a single ``q_proj`` replaces q_a/q_a_norm/q_b."""
+    H = c.num_heads
+    qk = c.qk_nope_head_dim + c.qk_rope_head_dim
+    shapes: Dict[str, Tuple[int, ...]] = {
+        "kv_a_proj": (n_layers, c.hidden_size,
+                      c.kv_lora_rank + c.qk_rope_head_dim),
+        "kv_a_norm": (n_layers, c.kv_lora_rank),
+        "kv_b_proj": (n_layers, c.kv_lora_rank,
+                      H * (c.qk_nope_head_dim + c.v_head_dim)),
+        "o_proj": (n_layers, H * c.v_head_dim, c.hidden_size),
+    }
+    if c.q_lora_rank > 0:
+        shapes.update({
+            "q_a_proj": (n_layers, c.hidden_size, c.q_lora_rank),
+            "q_a_norm": (n_layers, c.q_lora_rank),
+            "q_b_proj": (n_layers, c.q_lora_rank, H * qk),
+        })
+    else:
+        shapes["q_proj"] = (n_layers, c.hidden_size, H * qk)
+    return shapes
+
+
+def init_mla_params(c: ModelConfig, n_layers: int, key, dt) -> Params:
+    shapes = mla_param_shapes(c, n_layers)
+    keys = iter(jax.random.split(key, len(shapes)))
+    out: Params = {}
+    for name, shape in shapes.items():
+        if name.endswith("_norm"):
+            out[name] = jnp.ones(shape, dt)
+        else:
+            out[name] = (jax.random.normal(next(keys), shape, jnp.float32)
+                         * (shape[-2] ** -0.5)).astype(dt)
+    return out
+
+
+def mla_attention_block(
+    lp: Params,
+    config: ModelConfig,
+    x: jax.Array,                 # [T, Hm]
+    batch: Dict[str, jax.Array],
+    kv_cache: jax.Array,          # [L, slots, kv_lora_rank + rope] stacked
+    block_size: int,
+    attn_backend: str,
+    layer: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Weight-absorbed MLA over the paged latent cache.
+
+    Returns (attn_out [T, Hm], kv_cache')."""
+    c = config
+    T = x.shape[0]
+    H = c.num_heads
+    nope, rope = c.qk_nope_head_dim, c.qk_rope_head_dim
+    vdim = c.v_head_dim
+    R = c.kv_lora_rank
+    F = R + rope
+
+    # --- queries: low-rank down, norm, up (V3) or direct q_proj (V2-Lite) ---
+    if "q_a_proj" in lp:
+        cq = L.rms_norm(L.linear(x, lp["q_a_proj"]), lp["q_a_norm"],
+                        c.rms_norm_eps)
+        q = L.linear(cq, lp["q_b_proj"]).reshape(T, H, nope + rope)
+    else:
+        q = L.linear(x, lp["q_proj"]).reshape(T, H, nope + rope)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+
+    # --- latent KV row: c_kv (normed) | k_pe (RoPE, shared across heads) ---
+    kv_a = L.linear(x, lp["kv_a_proj"])                     # [T, R + rope]
+    c_kv = L.rms_norm(kv_a[:, :R], lp["kv_a_norm"], c.rms_norm_eps)
+    k_pe = kv_a[:, R:].reshape(T, 1, rope)
+
+    cos, sin = L.rope_cos_sin(batch["positions"], rope, c.rope_theta)
+    q_pe = L.apply_rope(q_pe, cos, sin)
+    k_pe = L.apply_rope(k_pe, cos, sin)[:, 0, :]            # [T, rope]
+
+    # --- absorb W_uk into the query: scores become one dot per cached row ---
+    # kv_b columns are head-major [h0:(nope|v), h1:(nope|v), ...] (HF
+    # layout) — reshape before splitting, never column-slice.
+    w_kv = lp["kv_b_proj"].reshape(R, H, nope + vdim)
+    w_uk, w_uv = w_kv[..., :nope], w_kv[..., nope:]
+    q_lat = jnp.einsum("thn,rhn->thr", q_nope.astype(jnp.float32),
+                       w_uk.astype(jnp.float32))            # [T, H, R]
+    q_eff = jnp.concatenate(
+        [q_lat, q_pe.astype(jnp.float32)], axis=-1).astype(x.dtype)  # [T,H,F]
+
+    row = jnp.concatenate([c_kv, k_pe], axis=-1)            # [T, F]
+    # Softmax scale comes from the UNABSORBED query dim (nope + rope).
+    scale = (nope + rope) ** -0.5
+
+    # KVH=1 (every head reads the same latent row); the v-cache aliases the
+    # k-cache — attended "values" are the first R columns of the key row.
+    kv_cache, _ = A.write_kv(
+        kv_cache, kv_cache, row.reshape(T, 1, F), row.reshape(T, 1, F),
+        batch["slot_mapping"], layer=layer)
+    out_lat = A.ragged_paged_attention_chunked(
+        q_eff, kv_cache, kv_cache, batch["token_seq_ids"],
+        batch["positions"], batch["block_tables"], batch["seq_lens"],
+        batch["qtok_idx"], batch["token_qpos"], block_size=block_size,
+        scale=scale, layer=layer)                           # [T, H, F]
+    out_lat = out_lat[..., :R].astype(jnp.float32)          # attended c_kv
+
+    # --- absorb W_uv: latent -> per-head value space, then output proj ---
+    attn = jnp.einsum("thr,rhv->thv", out_lat,
+                      w_uv.astype(jnp.float32)).astype(x.dtype)
+    return L.linear(attn.reshape(T, H * vdim), lp["o_proj"]), kv_cache
+
+
+def mla_sharding_rules():
+    """TP over heads: q_b/kv_b column-parallel (head-major last dim),
+    o_proj row-parallel; low-rank down-projections replicate (small)."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"layers/(q_proj|q_b_proj|kv_b_proj)", P(None, None, "tp")),
+        (r"layers/o_proj", P(None, "tp", None)),
+        # q_a/kv_a/norms replicate via the default rule.
+    ]

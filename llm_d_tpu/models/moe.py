@@ -68,13 +68,22 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         return (jax.random.normal(kk, shape, jnp.float32)
                 * (shape[-2] ** -0.5)).astype(dt)
 
-    dense = _attn_params(c, Ld, next(k), dt)
+    def attn_params(n, kk):
+        if c.use_mla:
+            from llm_d_tpu.models.mla import init_mla_params
+            p = init_mla_params(c, n, kk, dt)
+            p["input_norm"] = jnp.ones((n, c.hidden_size), dt)
+            p["post_attn_norm"] = jnp.ones((n, c.hidden_size), dt)
+            return p
+        return _attn_params(c, n, kk, dt)
+
+    dense = attn_params(Ld, next(k))
     dense.update({
         "gate_proj": w((Ld, c.hidden_size, c.intermediate_size), next(k)),
         "up_proj": w((Ld, c.hidden_size, c.intermediate_size), next(k)),
         "down_proj": w((Ld, c.intermediate_size, c.hidden_size), next(k)),
     })
-    moe = _attn_params(c, Lm, next(k), dt)
+    moe = attn_params(Lm, next(k))
     moe.update({
         "router": w((Lm, c.hidden_size, E), next(k)).astype(jnp.float32),
         "w_gate": w((Lm, E, c.hidden_size, Im), next(k)),
@@ -113,25 +122,37 @@ def forward(
     c = config
     Ld = c.first_dense_layers
     x = params["embed"][batch["token_ids"]]
+    cache_keys = ("kv",) if c.use_mla else ("k", "v")
+
+    def attend(lp, hn, caches, li):
+        """Attention dispatch: MLA (single latent buffer) or classic GQA."""
+        if c.use_mla:
+            from llm_d_tpu.models.mla import mla_attention_block
+            a, kv = mla_attention_block(
+                lp, c, hn, batch, caches[0], block_size, attn_backend,
+                layer=li)
+            return a, (kv,)
+        a, kv_k, kv_v = attention_block(
+            lp, c, hn, batch, caches[0], caches[1], block_size,
+            attn_backend, layer=li)
+        return a, (kv_k, kv_v)
 
     # Full stacked KV cache rides both scans' carries; each layer updates its
     # plane in place (see models.llama.forward) — no split/concat copies.
     def dense_body(carry, lp):
-        h, kv_k, kv_v, li = carry
-        a, kv_k, kv_v = attention_block(
-            lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
-            batch, kv_k, kv_v, block_size, attn_backend, layer=li)
+        h, caches, li = carry
+        a, caches = attend(
+            lp, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps), caches, li)
         h = h + a
         m = L.swiglu_mlp(
             L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps),
             lp["gate_proj"], lp["up_proj"], lp["down_proj"])
-        return (h + m, kv_k, kv_v, li + 1), None
+        return (h + m, caches, li + 1), None
 
     def moe_body(carry, lp):
-        h, kv_k, kv_v, li = carry
-        a, kv_k, kv_v = attention_block(
-            lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
-            batch, kv_k, kv_v, block_size, attn_backend, layer=li)
+        h, caches, li = carry
+        a, caches = attend(
+            lp, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps), caches, li)
         h = h + a
         hn = L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps)
         weights, idx = moe_ops.route(
@@ -150,27 +171,28 @@ def forward(
         if "shared_gate" in lp:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])
-        return (h + m, kv_k, kv_v, li + 1), idx
+        return (h + m, caches, li + 1), idx
 
-    (x, k_new, v_new, li), _ = jax.lax.scan(
-        dense_body, (x, kv_cache["k"], kv_cache["v"], jnp.int32(0)),
-        params["dense_layers"])
-    (x, k_new, v_new, _), routed = jax.lax.scan(
-        moe_body, (x, k_new, v_new, li), params["moe_layers"])
+    caches0 = tuple(kv_cache[k] for k in cache_keys)
+    (x, caches, li), _ = jax.lax.scan(
+        dense_body, (x, caches0, jnp.int32(0)), params["dense_layers"])
+    (x, caches, _), routed = jax.lax.scan(
+        moe_body, (x, caches, li), params["moe_layers"])
 
     x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
     sample_hidden = x[batch["sample_idx"]]
+    out_cache = dict(zip(cache_keys, caches))
     if collect_routed:
         # [Lm, T, k] logical ids for the engine's EPLB LoadTracker.
-        return sample_hidden, {"k": k_new, "v": v_new}, routed
-    return sample_hidden, {"k": k_new, "v": v_new}
+        return sample_hidden, out_cache, routed
+    return sample_hidden, out_cache
 
 
 def sharding_rules(config: ModelConfig):
     """TP for attention/shared experts (Megatron layout), EP over the
     flattened (dp, sp, tp) axes for routed experts — the wide-EP regime
     ("TPxDP in attention, EP in MoE layers"; reference decode.yaml:76,87)."""
-    return [
+    rules = [
         (r"embed", P(None, "tp")),
         (r"layers/(q|k|v)_proj", P(None, None, "tp")),
         (r"layers/(q|k|v)_bias", P(None, "tp")),
@@ -183,7 +205,24 @@ def sharding_rules(config: ModelConfig):
         (r"moe_layers/shared_down", P(None, "tp", None)),
         (r"lm_head", P(None, "tp")),
     ]
+    if config.use_mla:
+        from llm_d_tpu.models.mla import mla_sharding_rules
+        rules = mla_sharding_rules() + rules
+    return rules
 
 
-def kv_cache_spec() -> Dict[str, P]:
+def kv_cache_layout(config: ModelConfig) -> Dict[str, int]:
+    """Per-buffer cache row widths.  MLA caches ONE latent row per token
+    (kv_lora_rank + rope) — for V3 that is 576 values vs 32768 for
+    materialized heads, the memory profile wide-EP decode relies on."""
+    if config.use_mla:
+        return {"kv": config.kv_lora_rank + config.qk_rope_head_dim}
+    return {"k": config.num_kv_heads * config.head_dim_,
+            "v": config.num_kv_heads * config.head_dim_}
+
+
+def kv_cache_spec(config: Optional[ModelConfig] = None) -> Dict[str, P]:
+    if config is not None and config.use_mla:
+        # The latent row is shared by all (tp-sharded) heads: replicate.
+        return {"kv": P()}
     return {"k": P(None, None, "tp"), "v": P(None, None, "tp")}

@@ -39,6 +39,10 @@ logger = logging.getLogger(__name__)
 
 
 def _sampling_from_body(body: Dict[str, Any]) -> SamplingParams:
+    lp = body.get("logprobs")
+    if lp is True:
+        # Chat schema: boolean switch + separate top_logprobs count.
+        lp = int(body.get("top_logprobs", 0) or 1)
     return SamplingParams(
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
@@ -48,7 +52,7 @@ def _sampling_from_body(body: Dict[str, Any]) -> SamplingParams:
         stop=tuple(body.get("stop") or ()),
         seed=body.get("seed"),
         ignore_eos=bool(body.get("ignore_eos", False)),
-        logprobs=body.get("logprobs"),
+        logprobs=lp,
     )
 
 
@@ -310,23 +314,33 @@ class ModelServer:
             "usage": self._usage(req, body),
         }
         if req.sampling.logprobs and lp_ids:
-            # OpenAI completions logprobs block: per-token chosen logprob
-            # plus the top-N alternatives (weak #8: round 2 only returned
-            # the chosen token's value).
+            # Per-token chosen logprob plus top-N alternatives (weak #8:
+            # round 2 only returned the chosen token's value) — chat and
+            # completions use DIFFERENT OpenAI schemas.
             toks = [self.tokenizer.decode([t]) for t in lp_ids]
-            offsets, pos = [], 0
-            for t in toks:
-                offsets.append(pos)
-                pos += len(t)
-            payload["choices"][0]["logprobs"] = {
-                "tokens": toks,
-                "token_logprobs": lp_vals,
-                "top_logprobs": [
-                    {self.tokenizer.decode([tid]): lp
-                     for tid, lp in top.items()}
-                    for top in lp_tops] if lp_tops else None,
-                "text_offset": offsets,
-            }
+            if chat:
+                payload["choices"][0]["logprobs"] = {"content": [
+                    {"token": tok, "logprob": lp,
+                     "top_logprobs": [
+                         {"token": self.tokenizer.decode([tid]),
+                          "logprob": v} for tid, v in top.items()]}
+                    for tok, lp, top in zip(
+                        toks, lp_vals,
+                        lp_tops or [{}] * len(toks))]}
+            else:
+                offsets, pos = [], 0
+                for t in toks:
+                    offsets.append(pos)
+                    pos += len(t)
+                payload["choices"][0]["logprobs"] = {
+                    "tokens": toks,
+                    "token_logprobs": lp_vals,
+                    "top_logprobs": [
+                        {self.tokenizer.decode([tid]): lp
+                         for tid, lp in top.items()}
+                        for top in lp_tops] if lp_tops else None,
+                    "text_offset": offsets,
+                }
         if final_out is not None and final_out.kv_transfer_params:
             payload["kv_transfer_params"] = final_out.kv_transfer_params
         self._post_training_sample(req, arrival_feats)
@@ -433,7 +447,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     if args.config or args.config_overlay:
         from llm_d_tpu.utils.config import apply_file_config, load_layers
         layers = ([args.config] if args.config else []) + args.config_overlay
-        apply_file_config(args, p, load_layers(layers))
+        apply_file_config(args, p, load_layers(layers), argv=argv)
     if args.compilation_cache_dir:
         import jax
         jax.config.update("jax_compilation_cache_dir",

@@ -43,7 +43,9 @@ from llm_d_tpu.transfer import transport
 logger = logging.getLogger(__name__)
 
 _MAGIC = 0x4B565442  # "KVTB"
-_HEADER = struct.Struct("<IIIII")  # magic, num_layers, block_size, F, nb
+# magic, num_layers, block_size, num_buffers, nb
+_HEADER = struct.Struct("<IIIII")
+_BUF_HEADER = struct.Struct("<I")   # row width per buffer segment
 
 
 def _next_pow2(n: int, lo: int = 1) -> int:
@@ -264,68 +266,86 @@ class TpuConnector:
 @functools.lru_cache(maxsize=32)
 def _gather_fn(num_blocks: int, block_size: int):
     @jax.jit
-    def gather(k, v, block_ids):
+    def gather(buf, block_ids):
         # block_ids: [nb] int32 (padded entries point at the null block 0).
         slots = (block_ids[:, None] * block_size
                  + jnp.arange(block_size, dtype=jnp.int32)[None, :]).reshape(-1)
-        return jnp.stack([k[:, slots, :], v[:, slots, :]])  # [2, L, nb*bs, F]
+        return buf[:, slots, :]                   # [L, nb*bs, W]
     return gather
 
 
 @functools.lru_cache(maxsize=32)
 def _scatter_fn(num_blocks: int, block_size: int):
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def scatter(k, v, block_ids, slab):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scatter(buf, block_ids, slab):
         slots = (block_ids[:, None] * block_size
                  + jnp.arange(block_size, dtype=jnp.int32)[None, :]).reshape(-1)
-        return (k.at[:, slots, :].set(slab[0]),
-                v.at[:, slots, :].set(slab[1]))
+        return buf.at[:, slots, :].set(slab)
     return scatter
 
 
+def _cache_items(engine):
+    """Deterministically ordered cache buffers ({k, v} dense, {kv} MLA)."""
+    return sorted(engine.kv_cache.items())
+
+
 def _pack_blocks(engine, block_ids: List[int]) -> bytes:
-    k, v = engine.kv_cache["k"], engine.kv_cache["v"]
-    L, _, F = k.shape
     bs = engine.config.block_size
     nb = len(block_ids)
     nb_pad = _next_pow2(max(nb, 1))
-    ids = np.zeros(nb_pad, np.int32)   # pad gathers the null block; trimmed below
+    ids = np.zeros(nb_pad, np.int32)   # pad gathers the null block; trimmed
     ids[:nb] = block_ids
-    slab = _gather_fn(nb_pad, bs)(k, v, jnp.asarray(ids))
-    host = np.asarray(jax.device_get(slab))           # bf16 via ml_dtypes
-    host = host[:, :, :nb * bs, :]
-    header = _HEADER.pack(_MAGIC, L, bs, F, nb)
-    return header + host.tobytes()
+    ids_dev = jnp.asarray(ids)
+    items = _cache_items(engine)
+    L = items[0][1].shape[0]
+    parts = [_HEADER.pack(_MAGIC, L, bs, len(items), nb)]
+    for _, buf in items:
+        slab = _gather_fn(nb_pad, bs)(buf, ids_dev)
+        host = np.asarray(jax.device_get(slab))[:, :nb * bs, :]
+        parts.append(_BUF_HEADER.pack(buf.shape[2]))
+        parts.append(host.tobytes())
+    return b"".join(parts)
 
 
 def _scatter_blocks(engine, block_ids: List[int], blob: bytes) -> None:
     import ml_dtypes
-    k, v = engine.kv_cache["k"], engine.kv_cache["v"]
-    L, _, F = k.shape
     bs = engine.config.block_size
-    magic, bL, bbs, bF, bnb = _HEADER.unpack_from(blob, 0)
+    magic, bL, bbs, n_bufs, bnb = _HEADER.unpack_from(blob, 0)
     if magic != _MAGIC:
         raise ValueError("bad magic")
-    if (bL, bbs, bF) != (L, bs, F):
+    items = _cache_items(engine)
+    L = items[0][1].shape[0]
+    if (bL, bbs, n_bufs) != (L, bs, len(items)):
         raise ValueError(
-            f"slab layout {(bL, bbs, bF)} != cache layout {(L, bs, F)}")
+            f"slab layout {(bL, bbs, n_bufs)} != cache layout "
+            f"{(L, bs, len(items))}")
     nb = len(block_ids)
     if bnb < nb:
         raise ValueError(f"slab has {bnb} blocks, need {nb}")
-    payload = np.frombuffer(blob, dtype=ml_dtypes.bfloat16,
-                            offset=_HEADER.size)
-    slab = payload.reshape(2, L, bnb * bs, F)[:, :, :nb * bs, :]
     nb_pad = _next_pow2(max(nb, 1))
     if nb_pad != nb:
-        # Padded scatter targets must be real, distinct slots: route the pad
-        # writes into the null block's slots (block 0 is the trash block).
-        pad_slab = np.zeros((2, L, nb_pad * bs, F), ml_dtypes.bfloat16)
-        pad_slab[:, :, :nb * bs, :] = slab
-        slab = pad_slab
+        # Padded scatter targets must be real, distinct slots: route the
+        # pad writes into the null block (block 0 is the trash block).
         ids = np.zeros(nb_pad, np.int32)
         ids[:nb] = block_ids
     else:
         ids = np.asarray(block_ids, np.int32)
-    k_new, v_new = _scatter_fn(nb_pad, bs)(
-        k, v, jnp.asarray(ids), jnp.asarray(slab))
-    engine.kv_cache["k"], engine.kv_cache["v"] = k_new, v_new
+    ids_dev = jnp.asarray(ids)
+    off = _HEADER.size
+    for name, buf in items:
+        (width,) = _BUF_HEADER.unpack_from(blob, off)
+        off += _BUF_HEADER.size
+        if width != buf.shape[2]:
+            raise ValueError(
+                f"buffer {name!r}: slab width {width} != cache {buf.shape[2]}")
+        count = L * bnb * bs * width
+        payload = np.frombuffer(blob, dtype=ml_dtypes.bfloat16,
+                                offset=off, count=count)
+        off += count * 2
+        slab = payload.reshape(L, bnb * bs, width)[:, :nb * bs, :]
+        if nb_pad != nb:
+            pad = np.zeros((L, nb_pad * bs, width), ml_dtypes.bfloat16)
+            pad[:, :nb * bs, :] = slab
+            slab = pad
+        engine.kv_cache[name] = _scatter_fn(nb_pad, bs)(
+            buf, ids_dev, jnp.asarray(slab))

@@ -44,17 +44,26 @@ def load_layers(paths: Sequence[str]) -> Dict[str, Any]:
     return merged
 
 
-def apply_file_config(args, parser, merged: Dict[str, Any]) -> None:
+def apply_file_config(args, parser, merged: Dict[str, Any],
+                      argv: Optional[Sequence[str]] = None) -> None:
     """Overlay file config onto argparse results, CLI flags still winning.
 
     A file key ``max-num-seqs`` (or ``max_num_seqs``) maps to the argparse
-    dest; only values the user did NOT set explicitly on the CLI are
-    replaced (detected via a second parse against empty argv defaults)."""
+    dest.  A flag counts as CLI-set when its option string appears in
+    ``argv`` — comparing values against defaults would wrongly let the
+    file override an explicit flag that happens to equal the default."""
+    import sys
+    argv = list(argv if argv is not None else sys.argv[1:])
+    explicit = set()
+    for action in parser._actions:
+        for opt in action.option_strings:
+            if any(a == opt or a.startswith(opt + "=") for a in argv):
+                explicit.add(action.dest)
+                break
     defaults = {a.dest: a.default for a in parser._actions}
     for key, value in merged.items():
         dest = key.replace("-", "_")
         if dest not in defaults:
             raise ValueError(f"unknown config key {key!r}")
-        # CLI wins: only apply when the arg still holds its default.
-        if getattr(args, dest) == defaults[dest]:
+        if dest not in explicit:          # CLI wins
             setattr(args, dest, value)

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Load / error generator against a gateway or model server.
+
+The reference's monitoring playbook ships a load-and-error generator to
+populate dashboards and exercise error paths
+(docs/monitoring/scripts/generate-load-llmd.sh); this is that tool for the
+TPU stack, plus prefix-affinity and SLO-header traffic shapes so the
+scheduler's scorers and shed path light up.
+
+Examples:
+  python scripts/generate_load.py --url http://gw:8000 --qps 5 --duration 60
+  python scripts/generate_load.py --url http://gw:8000 --shape prefix \
+      --prefix-groups 4            # warms the prefix scorers
+  python scripts/generate_load.py --url http://gw:8000 --shape slo \
+      --slo-ttft-ms 200 --error-rate 0.1
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+
+import aiohttp
+
+WORDS = ("tpu mesh shard flash ring latent expert router block cache "
+         "prefill decode gateway").split()
+
+
+def make_body(args, rng: random.Random) -> tuple:
+    headers = {}
+    if args.shape == "prefix":
+        group = rng.randrange(args.prefix_groups)
+        prompt = (f"shared-prefix-{group} " * args.prefix_len
+                  + " ".join(rng.choices(WORDS, k=4)))
+    else:
+        prompt = " ".join(rng.choices(WORDS, k=args.prompt_words))
+    body = {"model": args.model, "prompt": prompt,
+            "max_tokens": args.max_tokens, "temperature": args.temperature}
+    if args.shape == "slo":
+        headers["x-prediction-based-scheduling"] = "true"
+        headers["x-slo-ttft-ms"] = str(args.slo_ttft_ms)
+        headers["x-slo-tpot-ms"] = str(args.slo_tpot_ms)
+        if rng.random() < 0.3:
+            body["priority"] = -1              # sheddable tier
+    if rng.random() < args.error_rate:
+        body = {"prompt": None, "max_tokens": "boom"}   # error traffic
+    return body, headers
+
+
+async def one_request(session, args, rng, stats) -> None:
+    body, headers = make_body(args, rng)
+    t0 = time.perf_counter()
+    try:
+        async with session.post(f"{args.url}/v1/completions", json=body,
+                                headers=headers) as resp:
+            await resp.read()
+            stats[resp.status] = stats.get(resp.status, 0) + 1
+    except Exception:
+        stats["error"] = stats.get("error", 0) + 1
+    stats.setdefault("latencies", []).append(time.perf_counter() - t0)
+
+
+async def run(args) -> None:
+    rng = random.Random(args.seed)
+    stats: dict = {}
+    deadline = time.monotonic() + args.duration
+    interval = 1.0 / args.qps
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=120)) as session:
+        pending = set()
+        while time.monotonic() < deadline:
+            pending.add(asyncio.create_task(
+                one_request(session, args, rng, stats)))
+            pending = {t for t in pending if not t.done()}
+            await asyncio.sleep(interval)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+    lats = sorted(stats.pop("latencies", []))
+    p = (lambda q: lats[min(int(q * len(lats)), len(lats) - 1)]
+         if lats else 0.0)
+    print(json.dumps({
+        "requests": sum(v for v in stats.values()),
+        "status_counts": stats,
+        "latency_p50_s": round(p(0.5), 4),
+        "latency_p90_s": round(p(0.9), 4),
+        "latency_p99_s": round(p(0.99), 4),
+    }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("generate-load")
+    ap.add_argument("--url", required=True)
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--qps", type=float, default=2.0)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--shape", choices=["uniform", "prefix", "slo"],
+                    default="uniform")
+    ap.add_argument("--prompt-words", type=int, default=24)
+    ap.add_argument("--prefix-groups", type=int, default=4)
+    ap.add_argument("--prefix-len", type=int, default=32)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
+    ap.add_argument("--slo-tpot-ms", type=float, default=50.0)
+    ap.add_argument("--error-rate", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    asyncio.run(run(ap.parse_args()))
+
+
+if __name__ == "__main__":
+    main()

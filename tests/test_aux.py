@@ -96,11 +96,13 @@ def test_config_file_wires_into_server_args(tmp_path):
     p.add_argument("--model", default="tiny")
     p.add_argument("--num-blocks", type=int, default=256)
     p.add_argument("--port", type=int, default=8200)
-    args = p.parse_args(["--port", "9999"])     # explicit CLI value
+    argv = ["--port", "9999", "--model", "tiny"]  # explicit, one == default
+    args = p.parse_args(argv)
     apply_file_config(args, p, {"model": "llama3-8b", "num-blocks": 4096,
-                                "port": 1234})
-    assert args.model == "llama3-8b"
+                                "port": 1234}, argv=argv)
+    # Explicit flags win even when their value equals the parser default.
+    assert args.model == "tiny"
     assert args.num_blocks == 4096
-    assert args.port == 9999                     # CLI wins over file
+    assert args.port == 9999
     with pytest.raises(ValueError):
-        apply_file_config(args, p, {"nonsense-key": 1})
+        apply_file_config(args, p, {"nonsense-key": 1}, argv=argv)
