@@ -224,7 +224,11 @@ class VariantAutoscaler:
             "inferno_current_replicas", "Ready replicas observed.",
             ["variant_name"], registry=self.registry,
         ).labels(variant_name=spec.model_id)
-        self.desired_replicas = spec.min_replicas
+        # Seed at >=1 even when min_replicas==0: "deliberately at zero"
+        # must be a state THIS loop decided (idle fleet observed), or a
+        # fresh/restarted autoscaler would tear down a cold-starting fleet
+        # whose replicas aren't ready yet.
+        self.desired_replicas = max(spec.min_replicas, 1)
         self._task: Optional[asyncio.Task] = None
 
     def decide(self, samples: List[ReplicaSample]) -> int:

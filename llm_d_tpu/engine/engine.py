@@ -74,6 +74,8 @@ class EngineConfig:
     # Tiered prefix cache: host-RAM blocks surviving device eviction
     # (reference: tiered-prefix-cache/cpu, OffloadingConnector role).
     kv_offload_blocks: int = 0            # 0 = off
+    # MoE expert-weight quantization (DeepGEMM role; "int8" or None).
+    quantization: Optional[str] = None
 
     def resolve_model(self) -> ModelConfig:
         return self.model_config or get_config(self.model)
@@ -114,6 +116,18 @@ class EngineCore:
         rules = self.model.sharding_rules(c)
         if params is None:
             params = self.model.init_params(c, jax.random.PRNGKey(config.seed))
+        if config.quantization == "int8":
+            if not c.is_moe:
+                # Silently serving bf16 while the operator believes HBM
+                # was halved is a misconfiguration, not a fallback.
+                raise ValueError(
+                    "quantization='int8' quantizes MoE expert weights; "
+                    f"model {c.name!r} is dense")
+            if "w_gate_q" not in params.get("moe_layers", {}):
+                from llm_d_tpu.ops.quant import quantize_moe_experts
+                params = quantize_moe_experts(params)
+        elif config.quantization is not None:
+            raise ValueError(f"unknown quantization {config.quantization!r}")
         shardings = logical_to_sharding(rules, params, self.mesh)
         self.params = shard_pytree(params, shardings)
         self.eplb = None
@@ -283,7 +297,7 @@ class EngineCore:
             if (sr.num_new_tokens != 1
                     or req.num_computed_tokens != req.num_tokens - 1
                     or req.do_remote_decode
-                    or req.sampling.logprobs):
+                    or req.sampling.logprobs is not None):
                 return None
             if req.num_tokens + K >= self.model_config.max_model_len:
                 return None
@@ -541,7 +555,8 @@ class EngineCore:
 
         batch, scheduled = self._build_batch(sched)
         self._rng, step_key = jax.random.split(self._rng)
-        want_top = any(sr.request.sampling.logprobs
+        # top_logprobs=0 means chosen-token logprob only (no alternatives).
+        want_top = any((sr.request.sampling.logprobs or 0) > 0
                        for sr in sched.scheduled)
         if want_top and self._step_fn_top is None:
             self._step_fn_top = self._build_step_fn(want_top_logprobs=True)
@@ -596,14 +611,15 @@ class EngineCore:
             self.metrics.generation_tokens.inc()
             finish = self._check_stop(req, token)
             top_lp = None
-            if req.sampling.logprobs and top is not None:
-                n = min(int(req.sampling.logprobs) or 1, top[0].shape[1])
+            if (req.sampling.logprobs or 0) > 0 and top is not None:
+                n = min(int(req.sampling.logprobs), top[0].shape[1])
                 top_lp = [{int(top[0][s, j]): float(top[1][s, j])
                            for j in range(n)}]
             out = RequestOutput(
                 req.request_id, [token], finish is not None,
                 finish_reason=finish,
-                logprobs=[float(logprobs[s])] if req.sampling.logprobs else None,
+                logprobs=([float(logprobs[s])]
+                          if req.sampling.logprobs is not None else None),
                 top_logprobs=top_lp)
             outputs.append(out)
             if finish is not None:

@@ -57,7 +57,34 @@ def _to_numpy(t: Any) -> np.ndarray:
     if str(t.dtype) == "torch.bfloat16":
         import torch
         return t.view(dtype=torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    if str(t.dtype) in ("torch.float8_e4m3fn", "torch.float8_e5m2"):
+        # FP8 bit-view (DeepSeek-V3/R1 checkpoints): numpy has no float8,
+        # so round-trip through uint8 into ml_dtypes.
+        import torch
+        target = (ml_dtypes.float8_e4m3fn
+                  if str(t.dtype) == "torch.float8_e4m3fn"
+                  else ml_dtypes.float8_e5m2)
+        return t.view(dtype=torch.uint8).numpy().view(target)
     return t.numpy()
+
+
+def fetch_weight(weights: Mapping[str, Any], name: str) -> np.ndarray:
+    """Fetch a tensor, dequantizing FP8 block-quantized checkpoints.
+
+    DeepSeek-V3/R1 ship FP8 weights with per-128x128-block
+    ``<name>_scale_inv`` tensors; serving weights dequantize to bf16/f32 at
+    load (the reference's vLLM does the same unless DeepGEMM consumes FP8
+    directly; our int8 path re-quantizes after load when enabled)."""
+    a = np.asarray(_to_numpy(weights[name]), dtype=None)
+    sname = f"{name}_scale_inv"
+    if sname in weights:
+        s = np.asarray(_to_numpy(weights[sname]), dtype=np.float32)
+        a = np.asarray(a, dtype=np.float32)
+        br = -(-a.shape[0] // s.shape[0])      # block sizes derived from
+        bc = -(-a.shape[1] // s.shape[1])      # the scale grid (HF: 128)
+        full = np.repeat(np.repeat(s, br, axis=0), bc, axis=1)
+        a = a * full[: a.shape[0], : a.shape[1]]
+    return np.asarray(a, dtype=np.float32)
 
 
 def load_dense_from_state_dict(
@@ -71,8 +98,7 @@ def load_dense_from_state_dict(
     dt = c.jax_dtype
 
     def arr(name):
-        a = np.asarray(_to_numpy(weights[name]), dtype=np.float32)
-        return a
+        return fetch_weight(weights, name)
 
     params: Dict[str, Any] = {
         "embed": jnp.asarray(arr(f"{prefix}embed_tokens.weight"), dt),
@@ -133,7 +159,7 @@ def load_moe_from_state_dict(
     Ld = c.first_dense_layers
 
     def arr(name):
-        return np.asarray(_to_numpy(weights[name]), dtype=np.float32)
+        return fetch_weight(weights, name)
 
     def stack(names, transpose):
         ws = [arr(n) for n in names]
@@ -237,9 +263,19 @@ def load_from_safetensors_dir(config: ModelConfig, path: str) -> Dict[str, Any]:
     if not files:
         raise FileNotFoundError(f"no .safetensors files under {path}")
     for fname in files:
-        with safe_open(os.path.join(path, fname), framework="np") as f:
+        fpath = os.path.join(path, fname)
+        torch_file = None
+        with safe_open(fpath, framework="np") as f:
             for key in f.keys():
-                weights[key] = f.get_tensor(key)
+                try:
+                    weights[key] = f.get_tensor(key)
+                except Exception:
+                    # The numpy framework cannot represent FP8 tensors
+                    # (DeepSeek FP8 checkpoints); torch can, and _to_numpy
+                    # bit-views them into ml_dtypes.
+                    if torch_file is None:
+                        torch_file = safe_open(fpath, framework="pt")
+                    weights[key] = _to_numpy(torch_file.get_tensor(key))
     if config.is_moe:
         return load_moe_from_state_dict(config, weights)
     return load_dense_from_state_dict(config, weights)

@@ -165,9 +165,10 @@ def forward(
                 idx, lp["replica_table"], lp["num_replicas"])
         else:
             phys_idx = idx
+        from llm_d_tpu.ops.quant import expert_weights
+        w_gate, w_up, w_down = expert_weights(lp, hn.dtype)
         m = moe_ops.expert_ffn(
-            hn, weights, phys_idx, lp["w_gate"], lp["w_up"], lp["w_down"],
-            mesh=mesh)
+            hn, weights, phys_idx, w_gate, w_up, w_down, mesh=mesh)
         if "shared_gate" in lp:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])

@@ -153,6 +153,12 @@ class LoadTracker:
         return float(self.load.max() / mean) if mean > 0 else 1.0
 
 
+def _expert_major_keys(moe_layers: Dict[str, Any]) -> List[str]:
+    """Keys of [L, E, ...] expert-major arrays (incl. int8 _q/_s pairs)."""
+    return [n for n in moe_layers
+            if n.startswith(("w_gate", "w_up", "w_down"))]
+
+
 @dataclasses.dataclass
 class EplbConfig:
     """Engine-facing knobs mirroring the reference's ``--eplb-config``
@@ -241,7 +247,7 @@ class EplbController:
         n_layers = ml["router"].shape[0]
         phys = jax.numpy.asarray(self.plan.phys_to_logical)
         ep_sharding = NamedSharding(mesh, P(None, AXIS_EP))
-        for name in ("w_gate", "w_up", "w_down"):
+        for name in _expert_major_keys(ml):
             ml[name] = jax.device_put(ml[name][:, phys], ep_sharding)
         rt, nr = self._stacked_tables(n_layers)
         repl = NamedSharding(mesh, P())
@@ -286,7 +292,7 @@ class EplbController:
         src_dev = jax.numpy.asarray(src)
         ep_sharding = NamedSharding(mesh, P(None, AXIS_EP))
         ml = dict(params["moe_layers"])
-        for name in ("w_gate", "w_up", "w_down"):
+        for name in _expert_major_keys(ml):
             ml[name] = jax.device_put(ml[name][:, src_dev], ep_sharding)
         self.plan = new_plan
         n_layers = ml["router"].shape[0]

@@ -41,8 +41,11 @@ logger = logging.getLogger(__name__)
 def _sampling_from_body(body: Dict[str, Any]) -> SamplingParams:
     lp = body.get("logprobs")
     if lp is True:
-        # Chat schema: boolean switch + separate top_logprobs count.
-        lp = int(body.get("top_logprobs", 0) or 1)
+        # Chat schema: boolean switch + separate alternatives count
+        # (0/absent = chosen-token logprob only, per the OpenAI schema).
+        lp = int(body.get("top_logprobs") or 0)
+    elif lp is False:
+        lp = None
     return SamplingParams(
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
@@ -291,7 +294,7 @@ class ModelServer:
         lp_tops: List[Dict[int, float]] = []
         async for out in self.async_engine.generate(req):
             final_out = out
-            if req.sampling.logprobs:
+            if req.sampling.logprobs is not None:
                 lp_ids.extend(out.new_token_ids)
                 lp_vals.extend(out.logprobs or [])
                 lp_tops.extend(out.top_logprobs or [])
@@ -313,7 +316,7 @@ class ModelServer:
             }],
             "usage": self._usage(req, body),
         }
-        if req.sampling.logprobs and lp_ids:
+        if req.sampling.logprobs is not None and lp_ids:
             # Per-token chosen logprob plus top-N alternatives (weak #8:
             # round 2 only returned the chosen token's value) — chat and
             # completions use DIFFERENT OpenAI schemas.
@@ -420,6 +423,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="host-RAM tier capacity in KV blocks (0 = off); evicted "
              "device blocks stay restorable (reference: tiered-prefix-cache)")
     p.add_argument(
+        "--quantization", default=None, choices=[None, "int8"],
+        help="MoE expert-weight quantization (DeepGEMM role; halves "
+             "expert HBM residency)")
+    p.add_argument(
         "--enable-eplb", action="store_true",
         help="MoE expert load balancing with redundant experts "
              "(reference: --enable-eplb, decode.yaml:79)")
@@ -468,6 +475,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         if args.tensor_parallel_size > 1 else None,
         allow_device_subset=args.allow_device_subset,
         kv_offload_blocks=args.kv_offload_blocks,
+        quantization=args.quantization,
         enable_eplb=args.enable_eplb,
         eplb_config=json.loads(args.eplb_config) if args.eplb_config else None)
     engine = None

@@ -55,11 +55,17 @@ def apply_file_config(args, parser, merged: Dict[str, Any],
     import sys
     argv = list(argv if argv is not None else sys.argv[1:])
     explicit = set()
-    for action in parser._actions:
-        for opt in action.option_strings:
-            if any(a == opt or a.startswith(opt + "=") for a in argv):
-                explicit.add(action.dest)
-                break
+    all_actions = parser._actions
+    for token in argv:
+        if not token.startswith("--"):
+            continue
+        base = token.split("=", 1)[0]
+        # Match exact option strings AND argparse's unambiguous-prefix
+        # abbreviations (--num-block matches --num-blocks).
+        hits = {a.dest for a in all_actions
+                for opt in a.option_strings if opt.startswith(base)}
+        if len(hits) == 1:
+            explicit.add(next(iter(hits)))
     defaults = {a.dest: a.default for a in parser._actions}
     for key, value in merged.items():
         dest = key.replace("-", "_")

@@ -1,0 +1,134 @@
+"""Int8 MoE expert-weight quantization (the DeepGEMM role analogue).
+
+Reference: FP8 grouped GEMM via DeepGEMM (VLLM_USE_DEEP_GEMM=1,
+decode.yaml:129-130).  Pins: quantization error bounds, forward parity
+within quantization noise, engine integration, EPLB interop (physical
+table gathers apply to the _q/_s pairs), memory halving.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+from llm_d_tpu.engine.request import Request
+from llm_d_tpu.models.config import ModelConfig, get_config
+from llm_d_tpu.ops import moe as moe_ops
+from llm_d_tpu.ops.quant import (
+    dequantize,
+    quantize_int8,
+    quantize_moe_experts,
+)
+from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.parallel.mesh import MeshConfig
+
+
+def test_quantize_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((4, 32, 16)) * 0.3, jnp.bfloat16)
+    q, s = quantize_int8(w)
+    assert q.dtype == jnp.int8 and s.shape == (4, 1, 16)
+    back = dequantize(q, s, jnp.float32)
+    err = np.abs(np.asarray(back) - np.asarray(w, np.float32))
+    amax = np.abs(np.asarray(w, np.float32)).max(axis=1, keepdims=True)
+    # Symmetric int8: error <= half a quantization step per column.
+    assert (err <= amax / 127.0 * 0.5 + 1e-6).all()
+
+
+def test_expert_ffn_int8_close_to_bf16():
+    rng = np.random.default_rng(1)
+    T, E, H, I, k = 16, 8, 32, 16, 2
+    cfg = ModelConfig(num_experts=E, num_experts_per_tok=k,
+                      moe_renormalize=True)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.bfloat16)
+    router = jnp.asarray(rng.standard_normal((H, E)), jnp.float32)
+    w_gate = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.bfloat16)
+    w_up = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.bfloat16)
+    w_down = jnp.asarray(rng.standard_normal((E, I, H)) * 0.2, jnp.bfloat16)
+    weights, idx = moe_ops.route(
+        jnp.dot(x.astype(jnp.float32), router), cfg)
+    full = moe_ops.expert_ffn(x, weights, idx, w_gate, w_up, w_down)
+    wq = [dequantize(*quantize_int8(w)) for w in (w_gate, w_up, w_down)]
+    quant = moe_ops.expert_ffn(x, weights, idx, *wq)
+    a, b = np.asarray(full, np.float32), np.asarray(quant, np.float32)
+    # Weight-only int8: outputs agree within quantization noise.
+    denom = max(np.abs(a).max(), 1e-6)
+    assert np.abs(a - b).max() / denom < 0.08
+    cos = (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9)
+    assert cos > 0.995
+
+
+def test_fp8_checkpoint_dequant_on_load():
+    """DeepSeek-V3/R1 HF checkpoints: FP8 weights + weight_scale_inv block
+    scales dequantize at load (loader.fetch_weight)."""
+    import ml_dtypes
+    from llm_d_tpu.models.loader import fetch_weight
+
+    rng = np.random.default_rng(7)
+    w_true = rng.standard_normal((256, 192)).astype(np.float32)
+    # Per-128x128-block scales, FP8-encoded payload (HF layout).
+    s = np.abs(w_true).reshape(2, 128, 2, 96).max(axis=(1, 3)) / 448.0
+    s = np.maximum(s, 1e-8)
+    full = np.repeat(np.repeat(s, 128, 0), 96, 1)
+    q = (w_true / full).astype(ml_dtypes.float8_e4m3fn)
+    weights = {"model.layers.0.x.weight": q,
+               "model.layers.0.x.weight_scale_inv": s.astype(np.float32)}
+    back = fetch_weight(weights, "model.layers.0.x.weight")
+    rel = np.abs(back - w_true) / (np.abs(w_true) + 1e-3)
+    assert np.median(rel) < 0.05          # FP8 e4m3 relative precision
+    # Non-quantized tensors pass through untouched.
+    weights2 = {"a.weight": w_true}
+    np.testing.assert_array_equal(fetch_weight(weights2, "a.weight"), w_true)
+
+
+def test_engine_int8_generates_and_halves_expert_bytes():
+    base = EngineCore(EngineConfig(
+        model="tiny-moe", block_size=4, num_blocks=64, max_num_seqs=8,
+        max_num_batched_tokens=64, min_token_bucket=16, min_seq_bucket=8))
+    host = jax.device_get(base.params)
+    q_engine = EngineCore(EngineConfig(
+        model="tiny-moe", block_size=4, num_blocks=64, max_num_seqs=8,
+        max_num_batched_tokens=64, min_token_bucket=16, min_seq_bucket=8,
+        quantization="int8"), params=host)
+    ml = q_engine.params["moe_layers"]
+    assert "w_gate_q" in ml and "w_gate" not in ml
+    assert ml["w_gate_q"].dtype == jnp.int8
+    # Payload bytes halve vs bf16 (scales are a rounding error).
+    bf16_bytes = np.prod(host["moe_layers"]["w_gate"].shape) * 2
+    int8_bytes = np.prod(ml["w_gate_q"].shape) * 1
+    assert int8_bytes * 2 == bf16_bytes
+
+    req = Request(request_id="q", prompt_token_ids=[3, 1, 4, 1, 5],
+                  sampling=SamplingParams(temperature=0.0, max_tokens=5,
+                                          ignore_eos=True))
+    out = q_engine.generate([req])
+    assert len(out["q"]) == 5
+
+
+def test_int8_with_eplb_on_mesh(devices):
+    """EPLB physical-table install + rebalance operate on the _q/_s pairs."""
+    engine = EngineCore(EngineConfig(
+        model="tiny-moe", block_size=4, num_blocks=64, max_num_seqs=8,
+        max_num_batched_tokens=64, min_token_bucket=16, min_seq_bucket=8,
+        mesh=MeshConfig(dp=4, sp=1, tp=2), quantization="int8",
+        enable_eplb=True,
+        eplb_config={"num_redundant_experts": 8, "step_interval": 4,
+                     "window_size": 50}))
+    ml = engine.params["moe_layers"]
+    E, P = 8, 16
+    assert ml["w_gate_q"].shape[1] == P          # physical table, int8
+    assert ml["w_gate_s"].shape[1] == P
+    reqs = [Request(request_id=f"e{i}", prompt_token_ids=[i + 2, 5, 9],
+                    sampling=SamplingParams(temperature=0.0, max_tokens=6,
+                                            ignore_eos=True))
+            for i in range(2)]
+    before = engine.generate(reqs)
+    assert engine.eplb.num_rebalances >= 1
+    # Still serving correctly after a rebalance moved int8 tables.
+    req2 = Request(request_id="post", prompt_token_ids=[7, 8, 9],
+                   sampling=SamplingParams(temperature=0.0, max_tokens=3,
+                                           ignore_eos=True))
+    out = engine.generate([req2])
+    assert len(out["post"]) == 3
+    assert all(len(v) == 6 for v in before.values())
