@@ -112,6 +112,12 @@ PRESETS = {
         name="llama3-1b", vocab_size=128256, hidden_size=2048,
         intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
         head_dim=64, rope_theta=500000.0, max_model_len=8192),
+    # Qwen3 MoE (no shared expert, softmax routing, qk-norm).
+    "qwen3-30b-a3b": ModelConfig(
+        name="qwen3-30b-a3b", vocab_size=151936, hidden_size=2048,
+        intermediate_size=6144, num_layers=48, num_heads=32, num_kv_heads=4,
+        head_dim=128, rope_theta=1000000.0, qk_norm=True, max_model_len=32768,
+        num_experts=128, num_experts_per_tok=8, moe_intermediate_size=768),
     "mixtral-8x22b": ModelConfig(
         name="mixtral-8x22b", vocab_size=32768, hidden_size=6144,
         intermediate_size=16384, num_layers=56, num_heads=48, num_kv_heads=8,

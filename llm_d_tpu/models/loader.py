@@ -80,10 +80,14 @@ def fetch_weight(weights: Mapping[str, Any], name: str) -> np.ndarray:
     if sname in weights:
         s = np.asarray(_to_numpy(weights[sname]), dtype=np.float32)
         a = np.asarray(a, dtype=np.float32)
-        br = -(-a.shape[0] // s.shape[0])      # block sizes derived from
-        bc = -(-a.shape[1] // s.shape[1])      # the scale grid (HF: 128)
-        full = np.repeat(np.repeat(s, br, axis=0), bc, axis=1)
-        a = a * full[: a.shape[0], : a.shape[1]]
+        # HF FP8 block quantization uses FIXED 128x128 blocks; the last
+        # block may be partial, so the grid must index by row//128 (a
+        # ceil-divided block size would mis-scale every tensor whose dim
+        # isn't a multiple of 128, e.g. kv_a_proj's 576 rows).
+        BLOCK = 128
+        ri = np.minimum(np.arange(a.shape[0]) // BLOCK, s.shape[0] - 1)
+        ci = np.minimum(np.arange(a.shape[1]) // BLOCK, s.shape[1] - 1)
+        a = a * s[np.ix_(ri, ci)]
     return np.asarray(a, dtype=np.float32)
 
 
@@ -265,17 +269,21 @@ def load_from_safetensors_dir(config: ModelConfig, path: str) -> Dict[str, Any]:
     for fname in files:
         fpath = os.path.join(path, fname)
         torch_file = None
-        with safe_open(fpath, framework="np") as f:
-            for key in f.keys():
-                try:
-                    weights[key] = f.get_tensor(key)
-                except Exception:
-                    # The numpy framework cannot represent FP8 tensors
-                    # (DeepSeek FP8 checkpoints); torch can, and _to_numpy
-                    # bit-views them into ml_dtypes.
-                    if torch_file is None:
-                        torch_file = safe_open(fpath, framework="pt")
-                    weights[key] = _to_numpy(torch_file.get_tensor(key))
+        try:
+            with safe_open(fpath, framework="np") as f:
+                for key in f.keys():
+                    try:
+                        weights[key] = f.get_tensor(key)
+                    except Exception:
+                        # The numpy framework cannot represent FP8 tensors
+                        # (DeepSeek FP8 checkpoints); torch can, and
+                        # _to_numpy bit-views them into ml_dtypes.
+                        if torch_file is None:
+                            torch_file = safe_open(fpath, framework="pt")
+                        weights[key] = _to_numpy(torch_file.get_tensor(key))
+        finally:
+            if torch_file is not None and hasattr(torch_file, "__exit__"):
+                torch_file.__exit__(None, None, None)
     if config.is_moe:
         return load_moe_from_state_dict(config, weights)
     return load_dense_from_state_dict(config, weights)

@@ -60,8 +60,13 @@ def apply_file_config(args, parser, merged: Dict[str, Any],
         if not token.startswith("--"):
             continue
         base = token.split("=", 1)[0]
-        # Match exact option strings AND argparse's unambiguous-prefix
-        # abbreviations (--num-block matches --num-blocks).
+        # argparse resolution order: an EXACT option match always wins
+        # (--config is not ambiguous with --config-overlay); otherwise an
+        # unambiguous prefix abbreviation counts (--num-block).
+        exact = {a.dest for a in all_actions if base in a.option_strings}
+        if exact:
+            explicit |= exact
+            continue
         hits = {a.dest for a in all_actions
                 for opt in a.option_strings if opt.startswith(base)}
         if len(hits) == 1:

@@ -72,15 +72,18 @@ def test_multistep_sharded_matches_single_device(devices, single_engine,
 
 
 @pytest.mark.parametrize("preset,tp", [("tiny", 2), ("qwen3-0.6b", 8),
-                                       ("llama3-8b", 8), ("llama3-70b", 8)])
+                                       ("llama3-8b", 8), ("llama3-70b", 8),
+                                       ("qwen3-30b-a3b", 4)])
 def test_sharding_rules_divide_evenly(devices, preset, tp):
     """Every preset's weight table divides over the TP degrees its guide
     deploys (reference: ms-pd/values_tpu.yaml:41-42 uses TP=8 on v6e)."""
+    from llm_d_tpu.models import get_model
     c = get_config(preset)
     if tp > len(devices):
         pytest.skip("virtual mesh too small")
+    model = get_model(c)
     mesh = make_mesh(MeshConfig(tp=tp), list(devices)[:tp])
     shapes = jax.eval_shape(
-        lambda k: llama.init_params(c, k), jax.random.PRNGKey(0))
-    problems = validate_divisibility(llama.sharding_rules(c), shapes, mesh)
+        lambda k: model.init_params(c, k), jax.random.PRNGKey(0))
+    problems = validate_divisibility(model.sharding_rules(c), shapes, mesh)
     assert problems == []

@@ -66,11 +66,17 @@ def test_fp8_checkpoint_dequant_on_load():
     from llm_d_tpu.models.loader import fetch_weight
 
     rng = np.random.default_rng(7)
-    w_true = rng.standard_normal((256, 192)).astype(np.float32)
-    # Per-128x128-block scales, FP8-encoded payload (HF layout).
-    s = np.abs(w_true).reshape(2, 128, 2, 96).max(axis=(1, 3)) / 448.0
-    s = np.maximum(s, 1e-8)
-    full = np.repeat(np.repeat(s, 128, 0), 96, 1)
+    # 576 rows: NOT a multiple of 128 (the kv_a_proj shape class that a
+    # ceil-derived block size silently mis-scales) -> 5x2 scale grid.
+    w_true = rng.standard_normal((576, 256)).astype(np.float32)
+    ri = np.minimum(np.arange(576) // 128, 4)
+    ci = np.minimum(np.arange(256) // 128, 1)
+    s = np.zeros((5, 2), np.float32)
+    for i in range(5):
+        for j in range(2):
+            blk = w_true[i * 128:(i + 1) * 128, j * 128:(j + 1) * 128]
+            s[i, j] = max(np.abs(blk).max() / 448.0, 1e-8)
+    full = s[np.ix_(ri, ci)]
     q = (w_true / full).astype(ml_dtypes.float8_e4m3fn)
     weights = {"model.layers.0.x.weight": q,
                "model.layers.0.x.weight_scale_inv": s.astype(np.float32)}
