@@ -127,17 +127,42 @@ def mla_attention_block(
     # Softmax scale comes from the UNABSORBED query dim (nope + rope).
     scale = (nope + rope) ** -0.5
 
-    # KVH=1 (every head reads the same latent row); the v-cache aliases the
-    # k-cache — attended "values" are the first R columns of the key row.
-    kv_cache, _ = A.write_kv(
-        kv_cache, kv_cache, row.reshape(T, 1, F), row.reshape(T, 1, F),
-        batch["slot_mapping"], layer=layer)
-    out_lat = A.ragged_paged_attention_chunked(
-        q_eff, kv_cache, kv_cache, batch["token_seq_ids"],
-        batch["positions"], batch["block_tables"], batch["seq_lens"],
-        batch["qtok_idx"], batch["token_qpos"], block_size=block_size,
-        scale=scale, layer=layer)                           # [T, H, F]
-    out_lat = out_lat[..., :R].astype(jnp.float32)          # attended c_kv
+    # The engine may lane-pad the cache row (F -> multiple of 128) so the
+    # Pallas decode kernel's page DMAs stay aligned; zero-padded query
+    # columns contribute exactly nothing to the scores.
+    F_cache = kv_cache.shape[-1]
+    if F_cache > F:
+        pad = F_cache - F
+        row = jnp.pad(row, ((0, 0), (0, pad)))
+        q_eff = jnp.pad(q_eff, ((0, 0), (0, 0), (0, pad)))
+
+    backend = A.resolve_backend(attn_backend)
+    qtok_idx = batch["qtok_idx"]
+    if backend == "pallas" and A.pallas_decode_eligible(
+            batch, block_size, F_cache):
+        # Decode hot path: single-buffer MQA kernel — each latent page is
+        # DMA'd once and used for both the score and value dots, with the
+        # new row spliced in place (ops/pallas/mla_attention.py).
+        from llm_d_tpu.ops.pallas.mla_attention import mla_paged_decode_update
+        rows_idx = qtok_idx[:, 0].clip(0, T - 1)
+        out, kv_cache = mla_paged_decode_update(
+            q_eff[rows_idx], row[rows_idx], kv_cache,
+            batch["block_tables"], batch["seq_lens"],
+            block_size=block_size, scale=scale, layer=layer)
+        out_lat = out[batch["token_seq_ids"]][..., :R].astype(jnp.float32)
+    else:
+        # KVH=1 (every head reads the same latent row); the v-cache aliases
+        # the k-cache — attended "values" are the row's first R columns.
+        kv_cache, _ = A.write_kv(
+            kv_cache, kv_cache, row.reshape(T, 1, F_cache),
+            row.reshape(T, 1, F_cache),
+            batch["slot_mapping"], layer=layer)
+        out_lat = A.ragged_paged_attention_chunked(
+            q_eff, kv_cache, kv_cache, batch["token_seq_ids"],
+            batch["positions"], batch["block_tables"], batch["seq_lens"],
+            qtok_idx, batch["token_qpos"], block_size=block_size,
+            scale=scale, layer=layer)                       # [T, H, F_cache]
+        out_lat = out_lat[..., :R].astype(jnp.float32)      # attended c_kv
 
     # --- absorb W_uv: latent -> per-head value space, then output proj ---
     attn = jnp.einsum("thr,rhv->thv", out_lat,

@@ -214,10 +214,18 @@ def sharding_rules(config: ModelConfig):
 
 def kv_cache_layout(config: ModelConfig) -> Dict[str, int]:
     """Per-buffer cache row widths.  MLA caches ONE latent row per token
-    (kv_lora_rank + rope) — for V3 that is 576 values vs 32768 for
-    materialized heads, the memory profile wide-EP decode relies on."""
+    (kv_lora_rank + rope, lane-padded) — for V3 that is 640 values vs
+    32768 for materialized heads, the memory profile wide-EP decode
+    relies on.
+
+    The MLA row ALWAYS lane-pads to a multiple of 128 (V3: 576 -> 640,
+    +11%): the Pallas decode kernel's page DMAs need the alignment, zero
+    columns are score-neutral (models/mla.py), and deriving the width
+    from config alone keeps the PD KV-transfer wire format identical
+    across backends (a CPU prefiller can feed a TPU decoder)."""
     if config.use_mla:
-        return {"kv": config.kv_lora_rank + config.qk_rope_head_dim}
+        w = config.kv_lora_rank + config.qk_rope_head_dim
+        return {"kv": -(-w // 128) * 128}
     return {"k": config.num_kv_heads * config.head_dim_,
             "v": config.num_kv_heads * config.head_dim_}
 

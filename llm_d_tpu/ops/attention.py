@@ -288,6 +288,22 @@ def ragged_paged_attention_chunked(
     return out[token_seq_ids, token_qpos]       # [T, H, D]
 
 
+def resolve_backend(backend: str) -> str:
+    """'auto' -> the platform's preferred implementation."""
+    if backend == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "reference"
+    return backend
+
+
+def pallas_decode_eligible(batch, block_size: int, row_width: int) -> bool:
+    """Shared gate for the Pallas decode kernels (dense and MLA):
+    pure-decode batch (Q == 1), bf16-sublane-aligned pages
+    (block_size % 16), 128-lane-aligned rows (row_width % 128)."""
+    qtok_idx = batch.get("qtok_idx")
+    return (qtok_idx is not None and qtok_idx.shape[1] == 1
+            and block_size % 16 == 0 and row_width % 128 == 0)
+
+
 def attention_with_kv_update(
     q: jax.Array,            # [T, H, D]
     k_new: jax.Array,        # [T, KVH, D] this step's K rows
@@ -315,17 +331,15 @@ def attention_with_kv_update(
     at 1B scale otherwise).
     Returns (attn_out [T, H, D], k_cache', v_cache').
     """
-    if backend == "auto":
-        backend = "pallas" if jax.default_backend() == "tpu" else "reference"
+    backend = resolve_backend(backend)
 
     qtok_idx = batch.get("qtok_idx")
-    # TPU DMA slices need sublane- and lane-aligned pages: the Pallas kernel
-    # requires block_size % 16 == 0 (bf16 sublane tiling) AND a folded KV row
-    # width (KVH*D) that is a multiple of 128 lanes; anything smaller falls
-    # back to the chunked XLA path instead of failing Mosaic compilation.
-    if backend == "pallas" and qtok_idx is not None \
-            and qtok_idx.shape[1] == 1 and soft_cap is None \
-            and block_size % 16 == 0 and k_cache.shape[-1] % 128 == 0:
+    # TPU DMA slices need sublane- and lane-aligned pages (see
+    # pallas_decode_eligible); anything smaller falls back to the chunked
+    # XLA path instead of failing Mosaic compilation.
+    if backend == "pallas" and soft_cap is None \
+            and pallas_decode_eligible(batch, block_size,
+                                       k_cache.shape[-1]):
         from llm_d_tpu.ops.pallas.paged_attention import (
             paged_attention_decode_update)
         T, H, D = q.shape

@@ -1,0 +1,173 @@
+"""Pallas TPU decode kernel for MLA (single latent cache buffer).
+
+The generic decode kernel (paged_attention.py) carries separate K and V
+buffers; MLA attends queries against ONE [slots, F] latent row per token
+(F = kv_lora_rank + rope, lane-padded) where the attended "values" are the
+same rows — so this kernel streams each page once, uses it for both the
+score dot and the value dot, and writes the new token's row back into its
+(already resident) page.  All H heads share the row (MQA): scores come
+from one [H, F] x [F, bs] MXU dot per page, no GQA zero-expansion needed.
+
+This is the DeepSeek-decode hot op the reference gets from vLLM's MLA CUDA
+kernels; the chunked XLA path remains the CPU/odd-shape fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _mla_decode_kernel(
+    # scalar prefetch
+    block_tables_ref,   # [S, B] SMEM
+    seq_lens_ref,       # [S]    SMEM (context length INCLUDING the new token)
+    layer_ref,          # [1]    SMEM (layer plane of the stacked cache)
+    # inputs
+    q_ref,              # [1, H, F] VMEM (absorbed query incl. rope part)
+    rn_ref,             # [1, 1, F] VMEM (this sequence's new latent row)
+    kv_hbm,             # [L, num_slots, F] (ANY -> HBM, aliased to output)
+    # outputs
+    o_ref,              # [1, H, F] VMEM (caller slices [:kv_lora_rank])
+    kv_out,             # aliased kv_hbm
+    # scratch
+    kv_buf,             # [2, bs, F] VMEM double buffer
+    sems,               # [2] DMA semaphores (page loads)
+    wsem,               # [1] DMA semaphore (page write-back)
+    *,
+    block_size: int,
+    scale: float,
+):
+    s = pl.program_id(0)
+    H, F = q_ref.shape[1], q_ref.shape[2]
+    bs = block_size
+    li = layer_ref[0]
+    seq_len = seq_lens_ref[s]
+    n_pages = pl.cdiv(seq_len, bs)
+    write_page = (seq_len - 1) // bs
+    w_row = (seq_len - 1) % bs
+
+    def page_dma(slot, j):
+        b = block_tables_ref[s, j]
+        start = pl.multiple_of(b * bs, bs)
+        return pltpu.make_async_copy(
+            kv_hbm.at[li, pl.ds(start, bs)], kv_buf.at[slot], sems.at[slot])
+
+    @pl.when(n_pages > 0)
+    def _():
+        page_dma(0, 0).start()
+
+    q = q_ref[0].astype(jnp.float32) * scale                  # [H, F]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (bs, F), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = j % 2
+
+        @pl.when(j + 1 < n_pages)
+        def _():
+            page_dma((j + 1) % 2, j + 1).start()
+
+        page_dma(slot, j).wait()
+
+        @pl.when(j == write_page)
+        def _():
+            # Splice the new token's latent row and write the page back.
+            upd = jnp.where(row_ids == w_row, rn_ref[0], kv_buf[slot])
+            kv_buf[slot] = upd
+            b = block_tables_ref[s, j]
+            start = pl.multiple_of(b * bs, bs)
+            wc = pltpu.make_async_copy(
+                kv_buf.at[slot], kv_out.at[li, pl.ds(start, bs)], wsem.at[0])
+            wc.start()
+            wc.wait()
+
+        page = kv_buf[slot].astype(jnp.float32)               # [bs, F]
+        s_hb = jax.lax.dot_general(
+            q, page, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [H, bs]
+        key_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s_hb = jnp.where(key_pos < seq_len, s_hb, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_hb, axis=-1, keepdims=True))
+        p = jnp.exp(s_hb - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, page, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [H, F]
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    init = (jnp.full((H, 1), -1e29, jnp.float32),
+            jnp.zeros((H, 1), jnp.float32),
+            jnp.zeros((H, F), jnp.float32))
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "scale", "interpret"))
+def mla_paged_decode_update(
+    q_eff: jax.Array,         # [S, H, F] absorbed queries
+    row_new: jax.Array,       # [S, F] new latent rows (one per sequence)
+    kv_cache: jax.Array,      # [L, num_slots, F] (or [num_slots, F])
+    block_tables: jax.Array,  # [S, B]
+    seq_lens: jax.Array,      # [S] incl. the new token
+    block_size: int,
+    scale: float,
+    layer: jax.Array | None = None,
+    interpret: bool = False,
+):
+    """Returns (attn_out [S, H, F] f32-accurate in q dtype, kv_cache')."""
+    S, H, F = q_eff.shape
+    squeeze = kv_cache.ndim == 2
+    if squeeze:
+        kv_cache = kv_cache[None]
+    layer_arr = jnp.asarray([0 if layer is None else layer], jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, H, F), lambda s, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, F), lambda s, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, F), lambda s, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, F), kv_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((1,)),
+        ],
+    )
+    kernel = functools.partial(
+        _mla_decode_kernel, block_size=block_size, scale=scale)
+    # Operand indices in input_output_aliases include scalar-prefetch args.
+    out, kv_cache = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((S, H, F), q_eff.dtype),
+            jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
+        ],
+        input_output_aliases={5: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",), has_side_effects=True),
+        interpret=interpret,
+    )(block_tables, seq_lens, layer_arr, q_eff,
+      row_new.reshape(S, 1, F).astype(kv_cache.dtype), kv_cache)
+    if squeeze:
+        kv_cache = kv_cache[0]
+    return out, kv_cache

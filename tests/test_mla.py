@@ -111,11 +111,14 @@ def greedy_req(rid, prompt, n=5):
 
 
 def test_mla_cache_is_latent_only(engine):
-    """THE MLA win: one buffer of kv_lora_rank + rope per token."""
+    """THE MLA win: one buffer of kv_lora_rank + rope (lane-padded to 128
+    for the Pallas kernel's page DMAs) per token."""
     assert set(engine.kv_cache) == {"kv"}
     F = engine.kv_cache["kv"].shape[-1]
-    assert F == CFG.kv_lora_rank + CFG.qk_rope_head_dim == 40
-    # vs materialized per-head K+V: H*(nope+rope) + H*vdim = 160/token.
+    raw = CFG.kv_lora_rank + CFG.qk_rope_head_dim
+    assert raw == 40 and F == 128          # padded to the lane multiple
+    # vs materialized per-head K+V: H*(nope+rope) + H*vdim = 160/token
+    # (for V3 the ratio is 640 vs 32768 — 51x).
     assert F < CFG.num_heads * (CFG.qk_nope_head_dim + CFG.qk_rope_head_dim
                                 + CFG.v_head_dim)
 

@@ -1,0 +1,102 @@
+"""MLA Pallas decode kernel: interpret-mode parity vs the jnp reference.
+
+The kernel streams each latent page once for both score and value dots
+(single-buffer MQA; ops/pallas/mla_attention.py).  Oracle: scatter the new
+row, then full-softmax ragged paged attention with q-dim = F and the
+v-cache aliased to the k-cache — exactly the math the chunked fallback
+runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_d_tpu.ops import attention as A
+from llm_d_tpu.ops.pallas.mla_attention import mla_paged_decode_update
+
+
+def _case(seed, S, H, F, block_size, num_blocks, seq_lens, num_layers=None):
+    rng = np.random.default_rng(seed)
+    shape = ((num_blocks * block_size, F) if num_layers is None
+             else (num_layers, num_blocks * block_size, F))
+    kv = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    B = max(-(-int(max(seq_lens)) // block_size), 1)
+    perm = rng.permutation(num_blocks - 1)[: S * B] + 1
+    bt = jnp.asarray(perm.reshape(S, B), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((S, H, F)), jnp.bfloat16)
+    row = jnp.asarray(rng.standard_normal((S, F)), jnp.bfloat16)
+    return q, row, kv, bt, jnp.asarray(seq_lens, jnp.int32)
+
+
+def _reference(q, row, kv, bt, lens, bs, scale, layer=None):
+    S, H, F = q.shape
+    slot = (jnp.take_along_axis(bt, ((lens - 1) // bs)[:, None],
+                                axis=1)[:, 0] * bs + (lens - 1) % bs)
+    kv, _ = A.write_kv(kv, kv, row.reshape(S, 1, F), row.reshape(S, 1, F),
+                       slot, layer=layer)
+    out = A.ragged_paged_attention_reference(
+        q, kv, kv, token_seq_ids=jnp.arange(S, dtype=jnp.int32),
+        positions=lens - 1, block_tables=bt, seq_lens=lens,
+        block_size=bs, scale=scale, layer=layer)
+    return out, kv
+
+
+@pytest.mark.parametrize("H,F,bs", [(4, 128, 16), (8, 256, 32), (2, 640, 16)])
+def test_mla_kernel_matches_reference(H, F, bs):
+    seq_lens = [1, bs // 2, bs, bs + 3, 3 * bs]
+    S = len(seq_lens)
+    scale = 0.17
+    q, row, kv, bt, lens = _case(hash((H, F, bs)) % 2**32, S, H, F, bs,
+                                 num_blocks=S * 3 + 1, seq_lens=seq_lens)
+    out, kv_upd = mla_paged_decode_update(
+        q, row, kv, bt, lens, block_size=bs, scale=scale, interpret=True)
+    ref_out, kv_ref = _reference(q, row, kv, bt, lens, bs, scale)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref_out, np.float32),
+                               atol=2e-2, rtol=2e-2)
+    np.testing.assert_array_equal(np.asarray(kv_upd, np.float32),
+                                  np.asarray(kv_ref, np.float32))
+
+
+def test_mla_kernel_stacked_layer_addressing():
+    H, F, bs, L = 4, 128, 16, 3
+    seq_lens = [5, 2 * bs + 1]
+    S = len(seq_lens)
+    q, row, kv, bt, lens = _case(9, S, H, F, bs, num_blocks=8,
+                                 seq_lens=seq_lens, num_layers=L)
+    layer = jnp.asarray(1, jnp.int32)
+    out, kv_upd = mla_paged_decode_update(
+        q, row, kv, bt, lens, block_size=bs, scale=0.2, layer=layer,
+        interpret=True)
+    ref_out, kv_ref = _reference(q, row, kv, bt, lens, bs, 0.2, layer=layer)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref_out, np.float32),
+                               atol=2e-2, rtol=2e-2)
+    np.testing.assert_array_equal(np.asarray(kv_upd, np.float32),
+                                  np.asarray(kv_ref, np.float32))
+    np.testing.assert_array_equal(np.asarray(kv_upd[0], np.float32),
+                                  np.asarray(kv[0], np.float32))
+
+
+def test_lane_padding_is_score_neutral():
+    """Padding the latent row with zero columns (and zero query columns)
+    must not change the attention output — the invariant that lets the
+    engine lane-pad V3's 576-wide row to 640 for the kernel."""
+    H, F, bs = 4, 96, 16            # 96 -> pad to 128
+    seq_lens = [7, bs + 2]
+    S = len(seq_lens)
+    q, row, kv, bt, lens = _case(11, S, H, F, bs, num_blocks=8,
+                                 seq_lens=seq_lens)
+    base, _ = _reference(q, row, kv, bt, lens, bs, 0.3)
+
+    pad = 128 - F
+    q_p = jnp.pad(q, ((0, 0), (0, 0), (0, pad)))
+    row_p = jnp.pad(row, ((0, 0), (0, pad)))
+    kv_p = jnp.pad(kv, ((0, 0), (0, pad)))
+    out_p, _ = mla_paged_decode_update(
+        q_p, row_p, kv_p, bt, lens, block_size=bs, scale=0.3,
+        interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out_p[..., :F], np.float32),
+        np.asarray(base[..., :F], np.float32), atol=2e-2, rtol=2e-2)
