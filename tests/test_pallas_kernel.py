@@ -90,6 +90,42 @@ def test_kernel_matches_reference(H, KVH, D, label, block_size):
         np.asarray(v_upd, np.float32), np.asarray(v_ref, np.float32))
 
 
+@pytest.mark.parametrize("seq_group", [1, 4, 8, 16])
+def test_kernel_sequence_grouping(seq_group):
+    """Grouped grid programs (G sequences per program) must match the oracle
+    with ragged lengths inside a group — including zero-length PAD rows,
+    whose clamped page re-reads must neither score nor write back."""
+    rng = np.random.default_rng(11 + seq_group)
+    H, KVH, D, bs = 8, 2, 64, 16
+    real_lens = [1, 7, bs, bs + 1, 2 * bs, 3 * bs - 1, 5, 2]
+    S_real = len(real_lens)
+    S = 16                                 # 8 real + 8 pad rows
+    seq_lens = real_lens + [0] * (S - S_real)
+    case = _make_decode_case(rng, S, H, KVH, D, bs, num_blocks=S * 3 + 1,
+                             seq_lens=seq_lens)
+    q, k_new, v_new, k_cache, v_cache, block_tables, lens = case
+    # Pad rows point at the null block, as the engine builds them.
+    block_tables = block_tables.at[S_real:].set(0)
+
+    out, k_upd, v_upd = paged_attention_decode_update(
+        q, k_new, v_new, k_cache, v_cache, block_tables, lens,
+        block_size=bs, num_kv_heads=KVH, interpret=True,
+        seq_group=seq_group)
+    ref_out, k_ref, v_ref = _reference_decode(
+        q[:S_real], k_new[:S_real], v_new[:S_real], k_cache, v_cache,
+        block_tables[:S_real], lens[:S_real], bs)
+
+    np.testing.assert_allclose(
+        np.asarray(out[:S_real], np.float32),
+        np.asarray(ref_out, np.float32), atol=2e-2, rtol=2e-2)
+    # Pad rows must not have scattered anything: the caches match an oracle
+    # that never saw them.
+    np.testing.assert_array_equal(
+        np.asarray(k_upd, np.float32), np.asarray(k_ref, np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(v_upd, np.float32), np.asarray(v_ref, np.float32))
+
+
 def test_kernel_stacked_cache_layer_addressing():
     """The stacked-cache form must touch ONLY the addressed layer plane."""
     rng = np.random.default_rng(7)
