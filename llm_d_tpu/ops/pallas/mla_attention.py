@@ -8,6 +8,13 @@ score dot and the value dot, and writes the new token's row back into its
 (already resident) page.  All H heads share the row (MQA): scores come
 from one [H, F] x [F, bs] MXU dot per page, no GQA zero-expansion needed.
 
+Sequence grouping mirrors paged_attention.py: each grid program owns G
+sequences (launch overhead inside the fused decode scan is ~45 us + ~3 us
+per program; one-sequence programs made that ~70% of dense decode step time
+before grouping).  The auto pick budgets VMEM for both the page double
+buffer (2*bs*F per sequence) and the f32 accumulator+query pair
+(8*H*F per sequence — DeepSeek's H=128 makes this the binding term).
+
 This is the DeepSeek-decode hot op the reference gets from vLLM's MLA CUDA
 kernels; the chunked XLA path remains the CPU/odd-shape fallback.
 """
@@ -23,6 +30,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+_GROUP_VMEM_BUDGET = 6 << 20
+
+
+def _pick_group(S: int, group, block_size: int, H: int, F: int,
+                itemsize: int) -> int:
+    if group is not None:
+        if group < 1 or S % group:
+            raise ValueError(
+                f"seq_group={group} must divide the sequence count S={S}")
+        return group
+    per_seq = 2 * block_size * F * itemsize + 8 * H * F
+    for g in (16, 8, 4, 2):
+        if S % g == 0 and g * per_seq <= _GROUP_VMEM_BUDGET:
+            return g
+    return 1
+
 
 def _mla_decode_kernel(
     # scalar prefetch
@@ -30,89 +53,115 @@ def _mla_decode_kernel(
     seq_lens_ref,       # [S]    SMEM (context length INCLUDING the new token)
     layer_ref,          # [1]    SMEM (layer plane of the stacked cache)
     # inputs
-    q_ref,              # [1, H, F] VMEM (absorbed query incl. rope part)
-    rn_ref,             # [1, 1, F] VMEM (this sequence's new latent row)
+    q_ref,              # [G, H, F] VMEM (absorbed queries incl. rope part)
+    rn_ref,             # [G, 1, F] VMEM (each sequence's new latent row)
     kv_hbm,             # [L, num_slots, F] (ANY -> HBM, aliased to output)
     # outputs
-    o_ref,              # [1, H, F] VMEM (caller slices [:kv_lora_rank])
+    o_ref,              # [G, H, F] VMEM (caller slices [:kv_lora_rank])
     kv_out,             # aliased kv_hbm
     # scratch
-    kv_buf,             # [2, bs, F] VMEM double buffer
-    sems,               # [2] DMA semaphores (page loads)
-    wsem,               # [1] DMA semaphore (page write-back)
+    kv_buf,             # [2, G, bs, F] VMEM double buffer
+    sems,               # [2, G] DMA semaphores (page loads)
+    wsems,              # [G] DMA semaphores (page write-back)
     *,
     block_size: int,
     scale: float,
+    group: int,
 ):
-    s = pl.program_id(0)
+    i = pl.program_id(0)
+    G = group
     H, F = q_ref.shape[1], q_ref.shape[2]
     bs = block_size
     li = layer_ref[0]
-    seq_len = seq_lens_ref[s]
-    n_pages = pl.cdiv(seq_len, bs)
-    write_page = (seq_len - 1) // bs
-    w_row = (seq_len - 1) % bs
+    base = i * G
+
+    seq_len_g = [seq_lens_ref[base + g] for g in range(G)]
+    n_pages_g = [pl.cdiv(sl, bs) for sl in seq_len_g]
+    n_max = n_pages_g[0]
+    for g in range(1, G):
+        n_max = jnp.maximum(n_max, n_pages_g[g])
+    write_page_g = [(sl - 1) // bs for sl in seq_len_g]
+    w_row_g = [(sl - 1) % bs for sl in seq_len_g]
 
     def page_dma(slot, j):
-        b = block_tables_ref[s, j]
-        start = pl.multiple_of(b * bs, bs)
-        return pltpu.make_async_copy(
-            kv_hbm.at[li, pl.ds(start, bs)], kv_buf.at[slot], sems.at[slot])
+        copies = []
+        for g in range(G):
+            # Clamped dead re-read for sequences out of pages (and pad rows).
+            jj = jnp.clip(j, 0, jnp.maximum(n_pages_g[g] - 1, 0))
+            b = block_tables_ref[base + g, jj]
+            start = pl.multiple_of(b * bs, bs)
+            copies.append(pltpu.make_async_copy(
+                kv_hbm.at[li, pl.ds(start, bs)], kv_buf.at[slot, g],
+                sems.at[slot, g]))
+        return copies
 
-    @pl.when(n_pages > 0)
+    @pl.when(n_max > 0)
     def _():
-        page_dma(0, 0).start()
+        for dma in page_dma(0, 0):
+            dma.start()
 
-    q = q_ref[0].astype(jnp.float32) * scale                  # [H, F]
-    row_ids = jax.lax.broadcasted_iota(jnp.int32, (bs, F), 0)
+    q = q_ref[...].astype(jnp.float32) * scale                # [G, H, F]
+    row_ids2 = jax.lax.broadcasted_iota(jnp.int32, (bs, F), 0)
+    # Per-group seq_len plane for score masking (iota/select chain — Mosaic
+    # has no scalar-vector stack/reshape).
+    g_ids = jax.lax.broadcasted_iota(jnp.int32, (G, 1, bs), 0)
+    sl_arr = jnp.zeros((G, 1, bs), jnp.int32)
+    for g in range(G):
+        sl_arr = jnp.where(g_ids == g, seq_len_g[g], sl_arr)
 
     def body(j, carry):
         m, l, acc = carry
         slot = j % 2
 
-        @pl.when(j + 1 < n_pages)
+        @pl.when(j + 1 < n_max)
         def _():
-            page_dma((j + 1) % 2, j + 1).start()
+            for dma in page_dma((j + 1) % 2, j + 1):
+                dma.start()
 
-        page_dma(slot, j).wait()
+        for dma in page_dma(slot, j):
+            dma.wait()
 
-        @pl.when(j == write_page)
-        def _():
-            # Splice the new token's latent row and write the page back.
-            upd = jnp.where(row_ids == w_row, rn_ref[0], kv_buf[slot])
-            kv_buf[slot] = upd
-            b = block_tables_ref[s, j]
-            start = pl.multiple_of(b * bs, bs)
-            wc = pltpu.make_async_copy(
-                kv_buf.at[slot], kv_out.at[li, pl.ds(start, bs)], wsem.at[0])
-            wc.start()
-            wc.wait()
+        # On each sequence's write page (exactly once per call): splice the
+        # new latent row into the resident page and write the page back.
+        for g in range(G):
+            @pl.when(j == write_page_g[g])
+            def _(g=g):
+                is_wr = row_ids2 == w_row_g[g]
+                kv_buf[slot, g] = jnp.where(is_wr, rn_ref[g], kv_buf[slot, g])
+                b = block_tables_ref[base + g, j]
+                start = pl.multiple_of(b * bs, bs)
+                wc = pltpu.make_async_copy(
+                    kv_buf.at[slot, g], kv_out.at[li, pl.ds(start, bs)],
+                    wsems.at[g])
+                wc.start()
+                wc.wait()
 
-        page = kv_buf[slot].astype(jnp.float32)               # [bs, F]
+        page = kv_buf[slot].astype(jnp.float32)               # [G, bs, F]
         s_hb = jax.lax.dot_general(
-            q, page, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [H, bs]
-        key_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
-        s_hb = jnp.where(key_pos < seq_len, s_hb, NEG_INF)
+            q, page, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)               # [G, H, bs]
+        key_pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (G, 1, bs), 2)
+        s_hb = jnp.where(key_pos < sl_arr, s_hb, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s_hb, axis=-1, keepdims=True))
-        p = jnp.exp(s_hb - m_new)
+        p = jnp.exp(s_hb - m_new)                             # [G, H, bs]
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, page, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [H, F]
+            p, page, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)               # [G, H, F]
         acc_new = acc * corr + pv
         return m_new, l_new, acc_new
 
-    init = (jnp.full((H, 1), -1e29, jnp.float32),
-            jnp.zeros((H, 1), jnp.float32),
-            jnp.zeros((H, F), jnp.float32))
-    m, l, acc = jax.lax.fori_loop(0, n_pages, body, init)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    init = (jnp.full((G, H, 1), -1e29, jnp.float32),
+            jnp.zeros((G, H, 1), jnp.float32),
+            jnp.zeros((G, H, F), jnp.float32))
+    m, l, acc = jax.lax.fori_loop(0, n_max, body, init)
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "scale", "interpret"))
+    jax.jit, static_argnames=("block_size", "scale", "interpret", "seq_group"))
 def mla_paged_decode_update(
     q_eff: jax.Array,         # [S, H, F] absorbed queries
     row_new: jax.Array,       # [S, F] new latent rows (one per sequence)
@@ -123,37 +172,39 @@ def mla_paged_decode_update(
     scale: float,
     layer: jax.Array | None = None,
     interpret: bool = False,
+    seq_group: int | None = None,   # sequences per grid program (None = auto)
 ):
     """Returns (attn_out [S, H, F] f32-accurate in q dtype, kv_cache')."""
     S, H, F = q_eff.shape
     squeeze = kv_cache.ndim == 2
     if squeeze:
         kv_cache = kv_cache[None]
+    G = _pick_group(S, seq_group, block_size, H, F, kv_cache.dtype.itemsize)
     layer_arr = jnp.asarray([0 if layer is None else layer], jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(S,),
+        grid=(S // G,),
         in_specs=[
-            pl.BlockSpec((1, H, F), lambda s, *_: (s, 0, 0),
+            pl.BlockSpec((G, H, F), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, F), lambda s, *_: (s, 0, 0),
+            pl.BlockSpec((G, 1, F), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((1, H, F), lambda s, *_: (s, 0, 0),
+            pl.BlockSpec((G, H, F), lambda i, *_: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, block_size, F), kv_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((1,)),
+            pltpu.VMEM((2, G, block_size, F), kv_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, G)),
+            pltpu.SemaphoreType.DMA((G,)),
         ],
     )
     kernel = functools.partial(
-        _mla_decode_kernel, block_size=block_size, scale=scale)
+        _mla_decode_kernel, block_size=block_size, scale=scale, group=G)
     # Operand indices in input_output_aliases include scalar-prefetch args.
     out, kv_cache = pl.pallas_call(
         kernel,

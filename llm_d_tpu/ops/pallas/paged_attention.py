@@ -15,7 +15,7 @@ sequence after the page loop.  This keeps every DMA 128-lane aligned even
 for head_dim 64 models and keeps the MXU fed with one large dot.
 
 Sequence grouping: each grid program handles a GROUP of ``G`` sequences
-(default 8).  A Mosaic kernel invocation embedded in the engine's fused
+(auto-picked: largest of 16/8/4/2 dividing S within the VMEM budget).  A Mosaic kernel invocation embedded in the engine's fused
 decode scan costs ~45 us of launch overhead plus ~3 us per grid program
 (measured on v5e; standalone back-to-back dispatches hide this, loop-carried
 ones cannot) — at S=64 with one sequence per program that overhead was ~70%
@@ -147,15 +147,14 @@ def _decode_kernel(
         for dma in page_dma(slot, j):
             dma.wait()
 
-        # Splice each group's new-token row into its write page (no-op rows
-        # elsewhere), then write back exactly the write pages.
-        for g in range(G):
-            is_wp = (write_page_g[g] == j) & (row_ids2 == w_row_g[g])
-            k_buf[slot, g] = jnp.where(is_wp, kn_ref[g], k_buf[slot, g])
-            v_buf[slot, g] = jnp.where(is_wp, vn_ref[g], v_buf[slot, g])
+        # On each sequence's write page (exactly once per call): splice the
+        # new-token row into the resident page and write the page back.
         for g in range(G):
             @pl.when(j == write_page_g[g])
             def _(g=g):
+                is_wr = row_ids2 == w_row_g[g]
+                k_buf[slot, g] = jnp.where(is_wr, kn_ref[g], k_buf[slot, g])
+                v_buf[slot, g] = jnp.where(is_wr, vn_ref[g], v_buf[slot, g])
                 b = block_tables_ref[base + g, j]
                 start = pl.multiple_of(b * bs, bs)
                 wk = pltpu.make_async_copy(
@@ -202,22 +201,26 @@ def _decode_kernel(
     o_ref[...] = out.astype(o_ref.dtype)
 
 
-# VMEM budget for the per-program page double-buffers (k_buf + v_buf =
-# 2 * 2 * G * block_size * F * itemsize bytes).  Keeps the auto-picked group
-# well under the ~16 MiB/core VMEM on v5e even for wide-row configs.
+# VMEM budget for the per-sequence kernel state: the page double-buffers
+# (k_buf + v_buf = 4 * block_size * F * itemsize bytes per sequence) PLUS
+# the f32 query/accumulator intermediates (q_full and acc are [H, F] f32
+# each -> 8 * H * F bytes per sequence; wide-GQA configs make this the
+# binding term).  Keeps the auto-picked group well under the ~16 MiB/core
+# VMEM on v5e.
 _GROUP_VMEM_BUDGET = 4 << 20
 
 
-def _pick_group(S: int, group, block_size: int, row_bytes: int) -> int:
+def _pick_group(S: int, group, block_size: int, H: int, F: int,
+                itemsize: int) -> int:
     if group is not None:
         if group < 1 or S % group:
             raise ValueError(
                 f"seq_group={group} must divide the sequence count S={S} "
                 "(grid programs each own exactly G sequences)")
         return group
-    page_bytes = 4 * block_size * row_bytes   # double buffer, K and V
+    per_seq = 4 * block_size * F * itemsize + 8 * H * F
     for g in (16, 8, 4, 2):
-        if S % g == 0 and g * page_bytes <= _GROUP_VMEM_BUDGET:
+        if S % g == 0 and g * per_seq <= _GROUP_VMEM_BUDGET:
             return g
     return 1
 
@@ -256,7 +259,7 @@ def paged_attention_decode_update(
         k_cache = k_cache[None]
         v_cache = v_cache[None]
     F = k_cache.shape[2]
-    G = _pick_group(S, seq_group, block_size, F * k_cache.dtype.itemsize)
+    G = _pick_group(S, seq_group, block_size, H, F, k_cache.dtype.itemsize)
     layer_arr = jnp.asarray(
         [0 if layer is None else layer], jnp.int32)
 

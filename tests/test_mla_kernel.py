@@ -59,6 +59,31 @@ def test_mla_kernel_matches_reference(H, F, bs):
                                   np.asarray(kv_ref, np.float32))
 
 
+@pytest.mark.parametrize("seq_group", [1, 4, 8])
+def test_mla_kernel_sequence_grouping(seq_group):
+    """Grouped programs must match the oracle with ragged lengths in a
+    group, including zero-length PAD rows (clamped dead reads: no score,
+    no write-back)."""
+    H, F, bs = 4, 128, 16
+    real_lens = [1, 7, bs, bs + 1, 2 * bs, 3 * bs - 1]
+    S_real = len(real_lens)
+    S = 8
+    seq_lens = real_lens + [0] * (S - S_real)
+    q, row, kv, bt, lens = _case(21 + seq_group, S, H, F, bs,
+                                 num_blocks=S * 3 + 1, seq_lens=seq_lens)
+    bt = bt.at[S_real:].set(0)     # pad rows point at the null block
+    out, kv_upd = mla_paged_decode_update(
+        q, row, kv, bt, lens, block_size=bs, scale=0.21, interpret=True,
+        seq_group=seq_group)
+    ref_out, kv_ref = _reference(
+        q[:S_real], row[:S_real], kv, bt[:S_real], lens[:S_real], bs, 0.21)
+    np.testing.assert_allclose(np.asarray(out[:S_real], np.float32),
+                               np.asarray(ref_out, np.float32),
+                               atol=2e-2, rtol=2e-2)
+    np.testing.assert_array_equal(np.asarray(kv_upd, np.float32),
+                                  np.asarray(kv_ref, np.float32))
+
+
 def test_mla_kernel_stacked_layer_addressing():
     H, F, bs, L = 4, 128, 16, 3
     seq_lens = [5, 2 * bs + 1]
