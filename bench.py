@@ -90,6 +90,7 @@ def main() -> None:
         max_num_seqs=n_seqs,
         max_num_batched_tokens=8192,
         num_scheduler_steps=32,
+        async_scheduling=True,
         # Disjoint warmup/timed prompts must not share KV anyway; disabling
         # removes any chance the warmup pass warms more than the compiles.
         enable_prefix_caching=False,

@@ -64,9 +64,17 @@ class EngineConfig:
     min_seq_bucket: int = 8
     # Fused multi-step decode: when a step is pure decode, run this many
     # engine steps in one device program with on-device token feedback —
-    # amortizes host<->device transfer latency (the reference's
-    # --async-scheduling analogue; decode.yaml:77,97).
+    # amortizes host<->device transfer latency.
     num_scheduler_steps: int = 1
+    # Async scheduling (the reference's --async-scheduling,
+    # decode.yaml:77,97): keep ONE fused decode block in flight and dispatch
+    # its successor — last token ids taken straight from the in-flight
+    # block's device array — before retiring it, so host-side token
+    # processing, stop checks and block allocation overlap device compute.
+    # Stops discovered at retire discard the successor's tokens for that
+    # request (same discard rule fused decode already has); new arrivals
+    # drain the pipeline and re-enter continuous batching.
+    async_scheduling: bool = False
     # EPLB (MoE models): redundant-expert load balancing
     # (reference: --enable-eplb --eplb-config, decode.yaml:79,100-104).
     enable_eplb: bool = False
@@ -95,6 +103,12 @@ class EngineCore:
         self.config = config
         self.model_config = config.resolve_model()
         c = self.model_config
+        if config.async_scheduling and config.num_scheduler_steps <= 1:
+            # The pipeline operates on fused decode blocks; without them the
+            # flag would be a silent no-op.
+            raise ValueError(
+                "async_scheduling requires num_scheduler_steps > 1 "
+                "(it pipelines fused decode blocks)")
 
         self.mesh = (make_mesh(config.mesh, devices,
                                allow_subset=config.allow_device_subset)
@@ -182,6 +196,9 @@ class EngineCore:
         if config.kv_offload_blocks > 0:
             from llm_d_tpu.engine.offload import HostKVTier
             self.host_tier = HostKVTier(self, config.kv_offload_blocks)
+
+        # Async scheduling: the one in-flight fused decode block.
+        self._inflight: Optional[Dict[str, Any]] = None
 
         self._step_fn = self._build_step_fn()
         # Variant computing top-N logprobs, compiled on first use (steps
@@ -316,10 +333,10 @@ class EngineCore:
             allocated.append((req, ok))
         return K
 
-    def _run_multistep(self, sched: SchedulerOutput, K: int) -> List[RequestOutput]:
+    def _ms_meta(self, scheduled) -> Dict[str, np.ndarray]:
+        """Host-side batch arrays for a fused decode block."""
         cfg = self.config
-        bs = cfg.block_size
-        S_real = len(sched.scheduled)
+        S_real = len(scheduled)
         S = _next_bucket(S_real, min(cfg.min_seq_bucket, cfg.max_num_seqs),
                          cfg.max_num_seqs)
         B = self.max_blocks_per_seq
@@ -333,7 +350,7 @@ class EngineCore:
         top_p = np.ones(S, np.float32)
         seeds = np.full(S, -1, np.int32)
         gen0 = np.zeros(S, np.int32)
-        for s, sr in enumerate(sched.scheduled):
+        for s, sr in enumerate(scheduled):
             req = sr.request
             last_ids[s] = req.all_token_ids[req.num_computed_tokens]
             pos0[s] = req.num_computed_tokens
@@ -347,31 +364,50 @@ class EngineCore:
                 # batch array (and kill the engine loop for the whole server).
                 seeds[s] = int(req.sampling.seed) & 0x7FFFFFFF
             gen0[s] = len(req.output_token_ids)
+        return dict(last_ids=last_ids, pos0=pos0, block_tables=block_tables,
+                    active=active, temperature=temperature, top_k=top_k,
+                    top_p=top_p, seeds=seeds, gen0=gen0)
 
-        mbatch = jax.device_put(dict(
-            last_ids=jnp.asarray(last_ids), pos0=jnp.asarray(pos0),
-            block_tables=jnp.asarray(block_tables),
-            active=jnp.asarray(active),
-            temperature=jnp.asarray(temperature),
-            top_k=jnp.asarray(top_k), top_p=jnp.asarray(top_p),
-            seeds=jnp.asarray(seeds), gen0=jnp.asarray(gen0)),
+    def _ms_dispatch(self, meta: Dict[str, Any], scheduled, K: int
+                     ) -> Dict[str, Any]:
+        """Launch one fused decode block; returns the in-flight record
+        WITHOUT synchronizing (ids stay on device until retire)."""
+        mbatch = jax.device_put(
+            {k: (v if isinstance(v, jax.Array) else jnp.asarray(v))
+             for k, v in meta.items()},
             self._replicated)
         self._rng, step_key = jax.random.split(self._rng)
         ids_ks, self.kv_cache, routed_ks = self._multistep_fn(
             self.params, self.kv_cache, mbatch, step_key)
-        ids_ks = np.asarray(jax.device_get(ids_ks))   # [K, S]
+        return dict(scheduled=list(scheduled), K=K, meta=meta,
+                    ids_dev=ids_ks, routed_dev=routed_ks)
+
+    def _ms_retire(self, inflight: Dict[str, Any]) -> List[RequestOutput]:
+        """Synchronize one in-flight block and advance request state."""
+        scheduled, K = inflight["scheduled"], inflight["K"]
+        S_real = len(scheduled)
+        ids_ks = np.asarray(jax.device_get(inflight["ids_dev"]))   # [K, S]
         self._step_count += K
         if self.eplb is not None:
             # Fused decode is EXACTLY the traffic EPLB exists to balance;
-            # only the first S_real rows are real sequences.
+            # only the first S_real rows are real sequences.  (A successor
+            # block already dispatched keeps using the pre-rebalance physical
+            # table+weights pair — consistent, balanced one block later.)
             self.params = self.eplb.on_step(
-                routed_ks[:, :, :S_real, :], self._step_count,
+                inflight["routed_dev"][:, :, :S_real, :], self._step_count,
                 self.params, self.mesh)
 
         outputs: List[RequestOutput] = []
         now = time.monotonic()
-        for s, sr in enumerate(sched.scheduled):
+        for s, sr in enumerate(scheduled):
             req = sr.request
+            if req.state is not RequestState.RUNNING:
+                # Finished (stop in an earlier retire) or aborted while this
+                # block was in flight: its tokens are discarded.  The zombie
+                # KV writes landed in rows past every live reader's masked
+                # length, in block-table order that device program order
+                # already sequenced before any reallocation's writes.
+                continue
             new_tokens: List[int] = []
             finish = None
             for k in range(K):
@@ -401,6 +437,82 @@ class EngineCore:
                 self.metrics.e2e_request_latency.observe(now - req.arrival_time)
         self._update_queue_metrics()
         return outputs
+
+    def _ms_try_extend(self, inflight: Dict[str, Any]
+                       ) -> Optional[Dict[str, Any]]:
+        """Dispatch the in-flight block's successor speculatively (before the
+        in-flight tokens are known): last ids come from the device array,
+        positions advance by K, fresh blocks are pre-allocated.  Returns the
+        new in-flight record, or None when the pipeline must drain (new
+        arrivals, rejections, allocation failure, or every request ending
+        within the current block)."""
+        if self._rejected or self.scheduler.waiting:
+            return None
+        if self.kv_connector is not None and self.kv_connector.has_pending():
+            return None
+        scheduled, K = inflight["scheduled"], inflight["K"]
+        meta = inflight["meta"]
+        S_real = len(scheduled)
+        max_len = self.model_config.max_model_len
+        live = 0
+        for s, sr in enumerate(scheduled):
+            req = sr.request
+            if req.state is not RequestState.RUNNING:
+                continue
+            if int(meta["pos0"][s]) + 2 * K >= max_len:
+                return None
+            if int(meta["gen0"][s]) + K < req.sampling.max_tokens:
+                live += 1
+        if live == 0:
+            return None     # everything finishes within the in-flight block
+        # Pre-allocate blocks covering the successor's K tokens.  Requests
+        # certain to finish (by length) inside the in-flight block get no
+        # allocation — they become pad rows below, so memory pressure from
+        # their dying breath can't drain the pipeline.
+        finishing = [int(meta["gen0"][s]) + K >= sr.request.sampling.max_tokens
+                     for s, sr in enumerate(scheduled)]
+        allocated: List[Tuple[Request, List[int]]] = []
+        for s, sr in enumerate(scheduled):
+            req = sr.request
+            if req.state is not RequestState.RUNNING or finishing[s]:
+                continue
+            ok = self.kv_manager.allocate(req, int(meta["pos0"][s]) + 2 * K)
+            if ok is None:
+                for r, blocks in reversed(allocated):
+                    self.kv_manager.release_tail(r, blocks)
+                return None
+            allocated.append((req, ok))
+
+        bt = meta["block_tables"]
+        next_bt = bt
+        next_active = meta["active"]
+        for s, sr in enumerate(scheduled):
+            if sr.request.state is not RequestState.RUNNING or finishing[s]:
+                # Requests that stopped in an earlier retire — or that will
+                # stop at their length limit in the in-flight block — become
+                # pad rows: seq_len 0 (no attention), trash-block writes.
+                if next_active is meta["active"]:
+                    next_active = next_active.copy()
+                next_active[s] = False
+                continue
+            nb = len(sr.request.block_ids)
+            if nb and bt[s, nb - 1] != sr.request.block_ids[-1]:
+                if next_bt is bt:
+                    next_bt = bt.copy()
+                next_bt[s, :nb] = sr.request.block_ids
+        next_meta = dict(
+            meta,
+            last_ids=inflight["ids_dev"][K - 1],   # device array, no sync
+            pos0=meta["pos0"] + np.int32(K),
+            gen0=meta["gen0"] + np.int32(K),
+            block_tables=next_bt,
+            active=next_active)
+        return self._ms_dispatch(next_meta, scheduled, K)
+
+    def _run_multistep(self, sched: SchedulerOutput, K: int) -> List[RequestOutput]:
+        return self._ms_retire(
+            self._ms_dispatch(self._ms_meta(sched.scheduled),
+                              sched.scheduled, K))
 
     # ---------- public API ----------
 
@@ -450,7 +562,8 @@ class EngineCore:
             self.kv_connector.abort(request_id)
 
     def has_work(self) -> bool:
-        if self.scheduler.has_work() or self._rejected:
+        if self.scheduler.has_work() or self._rejected \
+                or self._inflight is not None:
             return True
         return self.kv_connector is not None and self.kv_connector.has_pending()
 
@@ -540,6 +653,14 @@ class EngineCore:
             # Pump the connector: admit finished KV pulls, surface failed
             # ones, release producer pins the consumer acknowledged.
             outputs.extend(self.kv_connector.poll(self))
+        if self._inflight is not None:
+            # Pipelined decode: queue the successor block on the device
+            # FIRST, then retire the in-flight one — host-side token
+            # processing runs while the device crunches the successor.
+            nxt = self._ms_try_extend(self._inflight)
+            outputs.extend(self._ms_retire(self._inflight))
+            self._inflight = nxt
+            return outputs
         sched = self.scheduler.schedule()
         for req in sched.preempted:      # oversized requests finished by scheduler
             outputs.append(RequestOutput(
@@ -550,6 +671,10 @@ class EngineCore:
 
         K = self._try_multistep(sched)
         if K is not None:
+            if self.config.async_scheduling:
+                self._inflight = self._ms_dispatch(
+                    self._ms_meta(sched.scheduled), sched.scheduled, K)
+                return outputs    # this block's tokens arrive next step
             outputs.extend(self._run_multistep(sched, K))
             return outputs
 

@@ -28,23 +28,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_d_tpu.ops.pallas.paged_attention import pick_seq_group
+
 NEG_INF = -1e30
 
 _GROUP_VMEM_BUDGET = 6 << 20
-
-
-def _pick_group(S: int, group, block_size: int, H: int, F: int,
-                itemsize: int) -> int:
-    if group is not None:
-        if group < 1 or S % group:
-            raise ValueError(
-                f"seq_group={group} must divide the sequence count S={S}")
-        return group
-    per_seq = 2 * block_size * F * itemsize + 8 * H * F
-    for g in (16, 8, 4, 2):
-        if S % g == 0 and g * per_seq <= _GROUP_VMEM_BUDGET:
-            return g
-    return 1
 
 
 def _mla_decode_kernel(
@@ -179,7 +167,11 @@ def mla_paged_decode_update(
     squeeze = kv_cache.ndim == 2
     if squeeze:
         kv_cache = kv_cache[None]
-    G = _pick_group(S, seq_group, block_size, H, F, kv_cache.dtype.itemsize)
+    # Per-sequence VMEM: single latent page double-buffer + f32 q/acc pair.
+    G = pick_seq_group(
+        S, seq_group,
+        2 * block_size * F * kv_cache.dtype.itemsize + 8 * H * F,
+        budget=_GROUP_VMEM_BUDGET)
     layer_arr = jnp.asarray([0 if layer is None else layer], jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -202,25 +202,26 @@ def _decode_kernel(
 
 
 # VMEM budget for the per-sequence kernel state: the page double-buffers
-# (k_buf + v_buf = 4 * block_size * F * itemsize bytes per sequence) PLUS
-# the f32 query/accumulator intermediates (q_full and acc are [H, F] f32
-# each -> 8 * H * F bytes per sequence; wide-GQA configs make this the
-# binding term).  Keeps the auto-picked group well under the ~16 MiB/core
-# VMEM on v5e.
+# PLUS the f32 query/accumulator intermediates (q_full and acc are [H, F]
+# f32 each -> 8 * H * F bytes per sequence; wide-GQA and many-head MLA
+# configs make this the binding term).  Keeps the auto-picked group well
+# under the ~16 MiB/core VMEM on v5e.
 _GROUP_VMEM_BUDGET = 4 << 20
 
 
-def _pick_group(S: int, group, block_size: int, H: int, F: int,
-                itemsize: int) -> int:
+def pick_seq_group(S: int, group, per_seq_bytes: int,
+                   budget: int = _GROUP_VMEM_BUDGET) -> int:
+    """Sequences per grid program: explicit (validated) or the largest of
+    16/8/4/2 dividing S whose per-program state fits ``budget``.  Shared by
+    the dense and MLA decode kernels."""
     if group is not None:
         if group < 1 or S % group:
             raise ValueError(
                 f"seq_group={group} must divide the sequence count S={S} "
                 "(grid programs each own exactly G sequences)")
         return group
-    per_seq = 4 * block_size * F * itemsize + 8 * H * F
     for g in (16, 8, 4, 2):
-        if S % g == 0 and g * per_seq <= _GROUP_VMEM_BUDGET:
+        if S % g == 0 and g * per_seq_bytes <= budget:
             return g
     return 1
 
@@ -259,7 +260,10 @@ def paged_attention_decode_update(
         k_cache = k_cache[None]
         v_cache = v_cache[None]
     F = k_cache.shape[2]
-    G = _pick_group(S, seq_group, block_size, H, F, k_cache.dtype.itemsize)
+    # Per-sequence VMEM: K+V page double-buffers + f32 q_full/acc pair.
+    G = pick_seq_group(
+        S, seq_group,
+        4 * block_size * F * k_cache.dtype.itemsize + 8 * H * F)
     layer_arr = jnp.asarray(
         [0 if layer is None else layer], jnp.int32)
 

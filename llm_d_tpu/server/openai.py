@@ -410,6 +410,17 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)
     p.add_argument(
+        "--num-scheduler-steps", type=int, default=1,
+        help="fused decode steps per device program on pure-decode rounds; "
+             ">1 amortizes host<->device latency at the cost of coarser "
+             "streaming granularity")
+    p.add_argument(
+        "--async-scheduling", action="store_true",
+        help="pipeline fused decode: keep one block in flight and dispatch "
+             "its successor before retiring it; requires "
+             "--num-scheduler-steps > 1 (reference: --async-scheduling, "
+             "decode.yaml:77,97)")
+    p.add_argument(
         "--allow-device-subset", action="store_true",
         help="permit a mesh smaller than the host's device count "
              "(deliberately idle chips); default is to fail fast")
@@ -474,6 +485,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         mesh=MeshConfig(tp=args.tensor_parallel_size)
         if args.tensor_parallel_size > 1 else None,
         allow_device_subset=args.allow_device_subset,
+        num_scheduler_steps=args.num_scheduler_steps,
+        async_scheduling=args.async_scheduling,
         kv_offload_blocks=args.kv_offload_blocks,
         quantization=args.quantization,
         enable_eplb=args.enable_eplb,
