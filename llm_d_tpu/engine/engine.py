@@ -75,6 +75,13 @@ class EngineConfig:
     # request (same discard rule fused decode already has); new arrivals
     # drain the pipeline and re-enter continuous batching.
     async_scheduling: bool = False
+    # DBO (MoE models): dual-batch overlap — force >= 2 MoE dispatch chunks
+    # above the token threshold so the all-to-all of one chunk overlaps the
+    # expert GEMM of the other (reference: --enable-dbo
+    # --dbo-{decode,prefill}-token-threshold, decode.yaml:78,98-99).
+    enable_dbo: bool = False
+    dbo_decode_token_threshold: int = 32
+    dbo_prefill_token_threshold: int = 32
     # EPLB (MoE models): redundant-expert load balancing
     # (reference: --enable-eplb --eplb-config, decode.yaml:79,100-104).
     enable_eplb: bool = False
@@ -130,6 +137,10 @@ class EngineCore:
         rules = self.model.sharding_rules(c)
         if params is None:
             params = self.model.init_params(c, jax.random.PRNGKey(config.seed))
+        if config.enable_dbo and not c.is_moe:
+            raise ValueError(
+                "enable_dbo overlaps MoE dispatch with expert compute; "
+                f"model {c.name!r} is dense")
         if config.quantization == "int8":
             if not c.is_moe:
                 # Silently serving bf16 while the operator believes HBM
@@ -210,11 +221,27 @@ class EngineCore:
 
     # ---------- jitted step ----------
 
+    def _moe_opts(self) -> Optional[Dict[str, Any]]:
+        """MoE dispatch knobs, captured by every step program.  The model
+        picks the phase-specific DBO threshold from the program's static
+        query width (Q == 1 <=> pure decode — true for single-step and fused
+        decode alike; reference decode.yaml:98-99).  -1 = DBO explicitly
+        off: an engine-built program must not fall back to the standalone-op
+        env vars."""
+        if not self.model_config.is_moe:
+            return None
+        if not self.config.enable_dbo:
+            return dict(dbo_decode_min_tokens=-1, dbo_prefill_min_tokens=-1)
+        return dict(
+            dbo_decode_min_tokens=self.config.dbo_decode_token_threshold,
+            dbo_prefill_min_tokens=self.config.dbo_prefill_token_threshold)
+
     def _build_step_fn(self, want_top_logprobs: bool = False):
         c = self.model_config
         block_size = self.config.block_size
         backend = self.config.attn_backend
         model, mesh = self.model, self.mesh
+        moe_opts = self._moe_opts()
 
         collect_routed = self.eplb is not None
 
@@ -223,10 +250,11 @@ class EngineCore:
             if collect_routed:
                 hidden, kv_cache, routed = model.forward(
                     params, kv_cache, batch, c, block_size, backend,
-                    mesh=mesh, collect_routed=True)
+                    mesh=mesh, collect_routed=True, moe_opts=moe_opts)
             else:
                 hidden, kv_cache = model.forward(
-                    params, kv_cache, batch, c, block_size, backend, mesh=mesh)
+                    params, kv_cache, batch, c, block_size, backend,
+                    mesh=mesh, moe_opts=moe_opts)
                 routed = None
             logits = model.compute_logits(params, hidden, c)
             ids = sampling_ops.sample(
@@ -250,6 +278,7 @@ class EngineCore:
         block_size = self.config.block_size
         backend = self.config.attn_backend
         model, mesh = self.model, self.mesh
+        moe_opts = self._moe_opts()
 
         collect_routed = self.eplb is not None
 
@@ -280,11 +309,11 @@ class EngineCore:
                 if collect_routed:
                     hidden, kv_cache, routed = model.forward(
                         params, kv_cache, batch, c, block_size, backend,
-                        mesh=mesh, collect_routed=True)
+                        mesh=mesh, collect_routed=True, moe_opts=moe_opts)
                 else:
                     hidden, kv_cache = model.forward(
                         params, kv_cache, batch, c, block_size, backend,
-                        mesh=mesh)
+                        mesh=mesh, moe_opts=moe_opts)
                     routed = jnp.zeros((), jnp.int32)
                 logits = model.compute_logits(params, hidden, c)
                 ids = sampling_ops.sample(

@@ -109,6 +109,7 @@ def forward(
     block_size: int,
     attn_backend: str = "auto",
     mesh=None,                        # unused (MoE models need it for EP)
+    moe_opts=None,                    # unused (MoE dispatch knobs)
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One engine step over a ragged batch.
 

@@ -118,11 +118,19 @@ def forward(
     attn_backend: str = "auto",
     mesh: Optional[Mesh] = None,
     collect_routed: bool = False,   # also return [Lm, T, k] routed ids (EPLB)
+    moe_opts: Optional[Dict] = None,   # {"dbo_{decode,prefill}_min_tokens"}
 ):
     c = config
     Ld = c.first_dense_layers
     x = params["embed"][batch["token_ids"]]
     cache_keys = ("kv",) if c.use_mla else ("k", "v")
+    # DBO threshold by phase: the program's query width is static under jit,
+    # and Q == 1 holds exactly for pure-decode programs (single-step or
+    # fused).  None (no opts) lets the op consult its standalone env vars;
+    # -1 disables DBO outright.
+    is_decode = batch["qtok_idx"].shape[1] == 1
+    dbo_min_tokens = (moe_opts or {}).get(
+        "dbo_decode_min_tokens" if is_decode else "dbo_prefill_min_tokens")
 
     def attend(lp, hn, caches, li):
         """Attention dispatch: MLA (single latent buffer) or classic GQA."""
@@ -168,7 +176,8 @@ def forward(
         from llm_d_tpu.ops.quant import expert_weights
         w_gate, w_up, w_down = expert_weights(lp, hn.dtype)
         m = moe_ops.expert_ffn(
-            hn, weights, phys_idx, w_gate, w_up, w_down, mesh=mesh)
+            hn, weights, phys_idx, w_gate, w_up, w_down, mesh=mesh,
+            dbo_min_tokens=dbo_min_tokens)
         if "shared_gate" in lp:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])

@@ -252,6 +252,7 @@ def expert_ffn_a2a(
     w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array,
     mesh: Mesh,
     chunk_tokens: Optional[int] = None,
+    dbo_min_tokens: Optional[int] = None,
 ) -> jax.Array:
     """Sparse all-to-all EP dispatch (the DeepEP role; see module docstring).
 
@@ -267,6 +268,22 @@ def expert_ffn_a2a(
     T_loc = T // ep
     if chunk_tokens is None:
         chunk_tokens = int(os.environ.get("LLMD_MOE_DP_CHUNK_SIZE", "1024"))
+    # DBO (the reference's --enable-dbo, decode.yaml:78,98-99): when the
+    # BATCH reaches the token threshold, force at least TWO dispatch chunks.
+    # Chunks are data-independent, so XLA's async collectives overlap chunk
+    # i+1's ragged all-to-all with chunk i's grouped GEMM — the dual-batch
+    # compute/communication overlap, expressed as a schedule the compiler
+    # already knows how to pipeline.  The engine threads the phase-specific
+    # threshold in (decode vs prefill); the env vars are the standalone-op
+    # fallback.
+    # None -> standalone env fallback; negative -> explicitly disabled (an
+    # engine configured with enable_dbo=False must not inherit env state).
+    if dbo_min_tokens is None \
+            and os.environ.get("LLMD_MOE_DBO", "0") == "1":
+        dbo_min_tokens = int(os.environ.get("LLMD_DBO_TOKEN_THRESHOLD", "32"))
+    if dbo_min_tokens is not None and dbo_min_tokens >= 0 \
+            and T >= max(dbo_min_tokens, 2 * ep) and T_loc >= 2:
+        chunk_tokens = min(chunk_tokens, T_loc // 2)
     chunk_tokens = max(1, min(chunk_tokens, T_loc))
     while T_loc % chunk_tokens:
         chunk_tokens -= 1
@@ -310,6 +327,7 @@ def expert_ffn(
     w_down: jax.Array,     # [E, I, H]
     mesh: Optional[Mesh] = None,
     dispatch: str = "auto",   # auto | a2a | psum
+    dbo_min_tokens: Optional[int] = None,   # DBO: force >= 2 chunks at this T
 ) -> jax.Array:            # [T, H] in x.dtype
     """Routed-expert FFN, expert-parallel over the flattened mesh.
 
@@ -330,7 +348,8 @@ def expert_ffn(
     if dispatch == "auto":
         dispatch = "a2a" if (x.shape[0] % ep == 0 and E % ep == 0) else "psum"
     if dispatch == "a2a":
-        return expert_ffn_a2a(x, weights, idx, w_gate, w_up, w_down, mesh)
+        return expert_ffn_a2a(x, weights, idx, w_gate, w_up, w_down, mesh,
+                              dbo_min_tokens=dbo_min_tokens)
 
     sizes = [mesh.shape[a] for a in AXIS_EP]
 

@@ -438,6 +438,17 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="MoE expert-weight quantization (DeepGEMM role; halves "
              "expert HBM residency)")
     p.add_argument(
+        "--enable-dbo", action="store_true",
+        help="MoE dual-batch overlap: >=2 dispatch chunks above the token "
+             "threshold so all-to-all overlaps expert GEMM (reference: "
+             "--enable-dbo, decode.yaml:78)")
+    p.add_argument(
+        "--dbo-decode-token-threshold", type=int, default=32,
+        help="min tokens before DBO splits a decode batch (decode.yaml:98)")
+    p.add_argument(
+        "--dbo-prefill-token-threshold", type=int, default=32,
+        help="min tokens before DBO splits a prefill batch (prefill.yaml:79)")
+    p.add_argument(
         "--enable-eplb", action="store_true",
         help="MoE expert load balancing with redundant experts "
              "(reference: --enable-eplb, decode.yaml:79)")
@@ -489,6 +500,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         async_scheduling=args.async_scheduling,
         kv_offload_blocks=args.kv_offload_blocks,
         quantization=args.quantization,
+        enable_dbo=args.enable_dbo,
+        dbo_decode_token_threshold=args.dbo_decode_token_threshold,
+        dbo_prefill_token_threshold=args.dbo_prefill_token_threshold,
         enable_eplb=args.enable_eplb,
         eplb_config=json.loads(args.eplb_config) if args.eplb_config else None)
     engine = None

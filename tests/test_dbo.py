@@ -1,0 +1,166 @@
+"""DBO (dual-batch overlap): forced multi-chunk MoE dispatch.
+
+Reference: --enable-dbo with --dbo-{decode,prefill}-token-threshold
+(wide-ep decode.yaml:78,98-99; prefill.yaml:77-79).  The TPU expression of
+DBO: above the threshold, the a2a dispatch runs as >= 2 data-independent
+chunks, which XLA's async collectives pipeline (chunk i+1's all-to-all
+overlaps chunk i's expert GEMM).  These tests pin (a) the chunk-forcing
+behavior, (b) numerical parity with the unchunked path, and (c) the engine
+config plumbing and its dense-model guard.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+from llm_d_tpu.models.config import ModelConfig
+from llm_d_tpu.ops import moe as moe_ops
+from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh(devices):
+    return make_mesh(MeshConfig(dp=4, sp=1, tp=2), devices)
+
+
+@pytest.fixture
+def dbo_env():
+    os.environ["LLMD_MOE_DBO"] = "1"
+    os.environ["LLMD_DBO_TOKEN_THRESHOLD"] = "4"
+    yield
+    os.environ.pop("LLMD_MOE_DBO", None)
+    os.environ.pop("LLMD_DBO_TOKEN_THRESHOLD", None)
+
+
+def _case(seed, T, E, H=32, I=16):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.bfloat16)
+    router = jnp.asarray(rng.standard_normal((H, E)), jnp.float32)
+    w_gate = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.bfloat16)
+    w_up = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.bfloat16)
+    w_down = jnp.asarray(rng.standard_normal((E, I, H)) * 0.2, jnp.bfloat16)
+    return x, router, w_gate, w_up, w_down
+
+
+def test_dbo_forces_two_chunks_and_matches(mesh, dbo_env, monkeypatch):
+    """Above threshold: >= 2 chunks traced, output identical to DBO-off."""
+    cfg = ModelConfig(name="dbo-test", num_experts=16, num_experts_per_tok=2,
+                      moe_renormalize=True)
+    T = 64            # 8 tokens per EP shard >= threshold 4
+    x, router, w_gate, w_up, w_down = _case(3, T, 16)
+    weights, idx = moe_ops.route(
+        jnp.dot(x.astype(jnp.float32), router), cfg)
+
+    calls = []
+    real = moe_ops._a2a_moe_chunk
+    monkeypatch.setattr(moe_ops, "_a2a_moe_chunk",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    with_dbo = moe_ops.expert_ffn_a2a(
+        x, weights, idx, w_gate, w_up, w_down, mesh)
+    assert len(calls) >= 2, "DBO did not split the dispatch"
+
+    os.environ["LLMD_MOE_DBO"] = "0"
+    calls.clear()
+    without = moe_ops.expert_ffn_a2a(
+        x, weights, idx, w_gate, w_up, w_down, mesh)
+    assert len(calls) == 1, "expected a single chunk with DBO off"
+    np.testing.assert_allclose(np.asarray(with_dbo, np.float32),
+                               np.asarray(without, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_dbo_below_threshold_single_chunk(mesh, dbo_env, monkeypatch):
+    os.environ["LLMD_DBO_TOKEN_THRESHOLD"] = "128"   # above the T=64 batch
+    cfg = ModelConfig(name="dbo-test", num_experts=16, num_experts_per_tok=2,
+                      moe_renormalize=True)
+    x, router, w_gate, w_up, w_down = _case(4, 64, 16)
+    weights, idx = moe_ops.route(
+        jnp.dot(x.astype(jnp.float32), router), cfg)
+    calls = []
+    real = moe_ops._a2a_moe_chunk
+    monkeypatch.setattr(moe_ops, "_a2a_moe_chunk",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    moe_ops.expert_ffn_a2a(x, weights, idx, w_gate, w_up, w_down, mesh)
+    assert len(calls) == 1
+
+
+def _capture_thresholds(monkeypatch):
+    seen = []
+    real = moe_ops.expert_ffn
+    monkeypatch.setattr(
+        moe_ops, "expert_ffn",
+        lambda *a, **k: seen.append(k.get("dbo_min_tokens")) or real(*a, **k))
+    return seen
+
+
+def test_engine_selects_threshold_by_phase(monkeypatch):
+    """Prefill programs (Q > 1) get the prefill threshold, pure-decode
+    programs (Q == 1, even at num_scheduler_steps=1) the decode one."""
+    from llm_d_tpu.engine.request import Request
+    from llm_d_tpu.ops.sampling import SamplingParams
+
+    seen = _capture_thresholds(monkeypatch)
+    eng = EngineCore(EngineConfig(
+        model="tiny-moe", enable_dbo=True,
+        dbo_decode_token_threshold=7, dbo_prefill_token_threshold=99,
+        block_size=4, num_blocks=32, max_num_seqs=2,
+        max_num_batched_tokens=32, min_token_bucket=8, min_seq_bucket=2))
+    eng.generate([Request(
+        request_id="p", prompt_token_ids=[1, 2, 3, 4, 5],
+        sampling=SamplingParams(temperature=0.0, max_tokens=3,
+                                ignore_eos=True))])
+    assert 99 in seen, "prefill program missed the prefill threshold"
+    assert 7 in seen, "decode program missed the decode threshold"
+
+
+def test_engine_dbo_off_defeats_env(monkeypatch):
+    """enable_dbo=False must pass -1 (explicitly off), shielding engine
+    programs from stray LLMD_MOE_DBO env state."""
+    from llm_d_tpu.engine.request import Request
+    from llm_d_tpu.ops.sampling import SamplingParams
+
+    monkeypatch.setenv("LLMD_MOE_DBO", "1")
+    seen = _capture_thresholds(monkeypatch)
+    eng = EngineCore(EngineConfig(
+        model="tiny-moe", enable_dbo=False,
+        block_size=4, num_blocks=32, max_num_seqs=2,
+        max_num_batched_tokens=32, min_token_bucket=8, min_seq_bucket=2))
+    eng.generate([Request(
+        request_id="p", prompt_token_ids=[1, 2, 3],
+        sampling=SamplingParams(temperature=0.0, max_tokens=2,
+                                ignore_eos=True))])
+    assert seen and all(v == -1 for v in seen)
+
+
+def test_engine_dbo_guards_dense():
+    with pytest.raises(ValueError, match="dense"):
+        EngineCore(EngineConfig(model="tiny", enable_dbo=True,
+                                block_size=4, num_blocks=16))
+
+
+def test_engine_dbo_splits_prefill_dispatch(devices, monkeypatch):
+    """An enable_dbo engine on the EP mesh must trace >= 2 dispatch chunks
+    for a prefill batch above the prefill threshold — no env vars, the
+    threshold rides the step-program closure."""
+    from llm_d_tpu.engine.request import Request
+    from llm_d_tpu.ops.sampling import SamplingParams
+
+    calls = []
+    real = moe_ops._a2a_moe_chunk
+    monkeypatch.setattr(moe_ops, "_a2a_moe_chunk",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    eng = EngineCore(EngineConfig(
+        model="tiny-moe", enable_dbo=True,
+        dbo_decode_token_threshold=8, dbo_prefill_token_threshold=16,
+        mesh=MeshConfig(dp=4, sp=1, tp=2),
+        block_size=4, num_blocks=64, max_num_seqs=4,
+        max_num_batched_tokens=64, min_token_bucket=16, min_seq_bucket=2))
+    out = eng.generate([Request(
+        request_id="p", prompt_token_ids=list(range(1, 33)),   # T bucket 32
+        sampling=SamplingParams(temperature=0.0, max_tokens=2,
+                                ignore_eos=True))])
+    assert len(out["p"]) == 2
+    assert len(calls) >= 2, "prefill dispatch was not split"
