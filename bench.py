@@ -85,8 +85,8 @@ def main() -> None:
 
     cfg = EngineConfig(
         model="llama3-1b",
-        block_size=32,
-        num_blocks=2048,
+        block_size=64,      # fewer, larger page DMAs (~2% over bs=32)
+        num_blocks=1024,
         max_num_seqs=n_seqs,
         max_num_batched_tokens=8192,
         num_scheduler_steps=32,
