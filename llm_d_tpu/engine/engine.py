@@ -640,12 +640,11 @@ class EngineCore:
             start = req.num_computed_tokens
             toks = req.all_token_ids[start:start + n]
             token_ids[t:t + n] = toks
-            positions[t:t + n] = np.arange(start, start + n)
+            pos_arr = np.arange(start, start + n)
+            positions[t:t + n] = pos_arr
             token_seq_ids[t:t + n] = s
-            for j in range(n):
-                pos = start + j
-                blk = req.block_ids[pos // bs]
-                slot_mapping[t + j] = blk * bs + pos % bs
+            blocks = np.asarray(req.block_ids, np.int32)
+            slot_mapping[t:t + n] = blocks[pos_arr // bs] * bs + pos_arr % bs
             token_qpos[t:t + n] = np.arange(n)
             qtok_idx[s, :n] = np.arange(t, t + n)
             nb = len(req.block_ids)
@@ -717,11 +716,18 @@ class EngineCore:
         fn = self._step_fn_top if want_top else self._step_fn
         ids, logprobs, self.kv_cache, routed, top = fn(
             self.params, self.kv_cache, batch, step_key)
-        ids = np.asarray(jax.device_get(ids))
-        logprobs = np.asarray(jax.device_get(logprobs))
+        # ONE batched fetch: each device_get is a full tunnel round trip
+        # (~tens of ms against a remote chip), and chosen-token logprobs are
+        # only materialized when some request asked for them.
+        want_lp = any(sr.request.sampling.logprobs is not None
+                      for sr in sched.scheduled)
+        fetch = [ids] + ([logprobs] if want_lp else []) \
+            + (list(top) if top is not None else [])
+        fetched = jax.device_get(fetch)
+        ids = np.asarray(fetched[0])
+        logprobs = np.asarray(fetched[1]) if want_lp else None
         if top is not None:
-            top = (np.asarray(jax.device_get(top[0])),
-                   np.asarray(jax.device_get(top[1])))
+            top = (np.asarray(fetched[-2]), np.asarray(fetched[-1]))
         self._step_count += 1
         if self.eplb is not None:
             # Record routed logical ids (sampled; padding rows excluded so

@@ -99,6 +99,13 @@ PRESETS = {
         intermediate_size=3072, num_layers=28, num_heads=16, num_kv_heads=8,
         head_dim=128, rope_theta=1000000.0, qk_norm=True,
         tie_word_embeddings=True, max_model_len=32768),
+    # tiered-prefix-cache flagship (reference: Qwen/Qwen3-32B, tiered
+    # cpu/README.md benchmark model; offloading-connector TP=2).
+    "qwen3-32b": ModelConfig(
+        name="qwen3-32b", vocab_size=151936, hidden_size=5120,
+        intermediate_size=25600, num_layers=64, num_heads=64, num_kv_heads=8,
+        head_dim=128, rope_theta=1000000.0, qk_norm=True,
+        max_model_len=32768),
     "llama3-8b": ModelConfig(
         name="llama3-8b", vocab_size=128256, hidden_size=4096,
         intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,

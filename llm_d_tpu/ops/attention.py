@@ -168,21 +168,26 @@ def _flash_over_kv_chunks(
             "sqkgc,sckd->sqkgd", p, v)
         return m_new, l_new, acc_new
 
-    def chunk_step(carry, ci):
-        # Chunks entirely past the longest live context are skipped at
-        # runtime (scalar predicate -> only the taken branch executes), so
-        # HBM traffic tracks actual context length, not the padded table.
-        carry = jax.lax.cond(
-            ci * kv_chunk < max_len,
-            lambda c: compute_chunk(c, ci),
-            lambda c: c,
-            carry)
-        return carry, None
+    # Only chunks below the longest live context execute: a while_loop with
+    # a data-dependent trip count, NOT a scan of per-chunk lax.conds — the
+    # skipped-branch conds copied the full (m, l, acc) carry (~17 MB at the
+    # 64x128 prefill shape) once per dead chunk, which measured ~40% of the
+    # whole prefill step on v5e.  HBM traffic now tracks actual context
+    # length with no dead-chunk cost at all.
+    n_live = jnp.minimum(
+        (max_len + kv_chunk - 1) // kv_chunk, n_chunks).astype(jnp.int32)
 
-    init = (jnp.full((S, Q, KVH, G), -1e29, jnp.float32),
+    def chunk_step(carry):
+        ci, m, l, acc = carry
+        m, l, acc = compute_chunk((m, l, acc), ci)
+        return ci + 1, m, l, acc
+
+    init = (jnp.int32(0),
+            jnp.full((S, Q, KVH, G), -1e29, jnp.float32),
             jnp.zeros((S, Q, KVH, G), jnp.float32),
             jnp.zeros((S, Q, KVH, G, D), jnp.float32))
-    (m, l, acc), _ = jax.lax.scan(chunk_step, init, jnp.arange(n_chunks))
+    _, m, l, acc = jax.lax.while_loop(
+        lambda c: c[0] < n_live, chunk_step, init)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.reshape(S, Q, H, D).astype(qs.dtype)
 
@@ -248,6 +253,19 @@ def _flash_batched_q_chunks(
     return jnp.moveaxis(outs, 0, 1).reshape(S, Q, H, D)
 
 
+def gather_per_seq_queries(q, positions, qtok_idx):
+    """[T, H, D] ragged queries -> ([S, Q, H, D], [S, Q] positions).
+
+    qtok_idx's pad sentinel is T: one zero query row / -1 position is
+    appended so pad slots gather a fully-masked row.  Shared by the chunked
+    XLA path and the Pallas prefill kernel dispatch."""
+    T, H, D = q.shape
+    q_pad = jnp.concatenate([q, jnp.zeros((1, H, D), q.dtype)])
+    pos_pad = jnp.concatenate(
+        [positions, jnp.full((1,), -1, positions.dtype)])
+    return q_pad[qtok_idx], pos_pad[qtok_idx]
+
+
 def ragged_paged_attention_chunked(
     q: jax.Array,              # [T, H, D]
     k_cache: jax.Array, v_cache: jax.Array,
@@ -269,10 +287,7 @@ def ragged_paged_attention_chunked(
     scale = scale if scale is not None else D ** -0.5
     C = B * block_size
 
-    q_pad = jnp.concatenate([q, jnp.zeros((1, H, D), q.dtype)])
-    pos_pad = jnp.concatenate([positions, jnp.full((1,), -1, positions.dtype)])
-    qs = q_pad[qtok_idx]                        # [S, Q, H, D]
-    q_pos = pos_pad[qtok_idx]                   # [S, Q]
+    qs, q_pos = gather_per_seq_queries(q, positions, qtok_idx)
     slot_ids = (block_tables[:, :, None] * block_size
                 + jnp.arange(block_size)[None, None, :]).reshape(S, C)
 
@@ -354,6 +369,23 @@ def attention_with_kv_update(
 
     k_cache, v_cache = write_kv(
         k_cache, v_cache, k_new, v_new, batch["slot_mapping"], layer=layer)
+    if backend == "pallas" and qtok_idx is not None \
+            and qtok_idx.shape[1] > 1 and block_size % 16 == 0 \
+            and k_cache.shape[-1] % 128 == 0:
+        # Prefill / mixed batches: flash kernel streaming KV pages through
+        # VMEM (scatter-then-read; no aliasing needed).  Same lane/sublane
+        # gates as the decode kernel.
+        from llm_d_tpu.ops.pallas.flash_prefill import flash_prefill_paged
+        D = q.shape[-1]
+        qs, q_pos = gather_per_seq_queries(
+            q, batch["positions"], qtok_idx)
+        out_s = flash_prefill_paged(
+            qs, q_pos, k_cache, v_cache,
+            batch["block_tables"], batch["seq_lens"],
+            block_size=block_size, num_kv_heads=k_cache.shape[-1] // D,
+            scale=scale, soft_cap=soft_cap, layer=layer)
+        return out_s[batch["token_seq_ids"], batch["token_qpos"]], \
+            k_cache, v_cache
     if backend in ("pallas", "chunked") and qtok_idx is not None:
         out = ragged_paged_attention_chunked(
             q, k_cache, v_cache, batch["token_seq_ids"], batch["positions"],

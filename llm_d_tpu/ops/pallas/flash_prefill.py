@@ -1,0 +1,227 @@
+"""Pallas TPU flash-attention kernel for prefill / mixed batches.
+
+The chunked XLA prefill path materializes [S, Q, KVH, G, kv_chunk] f32
+score tensors in HBM (~134 MB per (layer, q-chunk) at the 64x128 bench
+shape) and pays several elementwise passes over them — measured ~48% of the
+prefill step on v5e.  This kernel runs the flash recurrence entirely in
+VMEM: each grid program owns one sequence's q-tile, streams that sequence's
+KV pages through a double buffer (same DMA pattern as the decode kernel),
+and leaves only the tile's outputs in HBM.
+
+Everything inside the kernel lives in the FUSED row space [Qt*H, *] (row
+r = query-slot r//H, head r%H), so there are no vector reshapes for Mosaic
+to reject: the wrapper pre-shapes queries to [S, Q*H, D] and positions to
+[S, Q*H, 1], and un-fuses the [S, Q*H, D] output outside the kernel.  GQA
+uses the zero-expansion trick (see paged_attention.py): queries fold to
+[Qt*H, KVH*D] with one nonzero D-block per head, scores for the whole tile
+come from ONE MXU dot per page, and values accumulate in folded space,
+unfolded once at the end.
+
+Causality bounds the page loop per tile: pages past min(seq_len,
+max q-position + 1) are never streamed.  KV rows for the tokens being
+computed are scattered into the cache by the caller BEFORE the kernel runs
+(write_kv) — this kernel only reads, so no aliasing contract is needed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    block_tables_ref,   # [S, B] SMEM
+    seq_lens_ref,       # [S]    SMEM
+    layer_ref,          # [1]    SMEM
+    # inputs
+    q_ref,              # [1, Qt*H, D] VMEM (fused rows: slot-major, head-minor)
+    qpos_ref,           # [1, Qt*H, 1] VMEM i32 (position per row; pad -> -1)
+    k_hbm,              # [L, num_slots, KVH*D] (ANY)
+    v_hbm,
+    # outputs
+    o_ref,              # [1, Qt*H, D] VMEM
+    # scratch
+    k_buf,              # [2, bs, KVH*D] VMEM
+    v_buf,
+    sems,               # [2, 2] DMA semaphores
+    *,
+    block_size: int,
+    num_heads: int,
+    num_kv_heads: int,
+    scale: float,
+    soft_cap: float | None,
+):
+    s = pl.program_id(0)
+    R, D = q_ref.shape[1], q_ref.shape[2]     # R = Qt * H
+    H = num_heads
+    KVH = num_kv_heads
+    G = H // KVH
+    F = KVH * D
+    bs = block_size
+    li = layer_ref[0]
+    seq_len = seq_lens_ref[s]
+
+    q_pos = qpos_ref[0]                                       # [R, 1] i32
+    qmax = jnp.max(q_pos)
+    # Causal bound: keys at positions > qmax never score for this tile.
+    live = jnp.minimum(seq_len, qmax + 1)
+    n_pages = pl.cdiv(jnp.maximum(live, 0), bs)
+
+    def page_dma(slot, j):
+        b = block_tables_ref[s, j]
+        start = pl.multiple_of(b * bs, bs)
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[li, pl.ds(start, bs)], k_buf.at[slot],
+                sems.at[slot, 0]),
+            pltpu.make_async_copy(
+                v_hbm.at[li, pl.ds(start, bs)], v_buf.at[slot],
+                sems.at[slot, 1]),
+        )
+
+    @pl.when(n_pages > 0)
+    def _():
+        for dma in page_dma(0, 0):
+            dma.start()
+
+    # Zero-expanded queries in fused row space: row r belongs to head r % H,
+    # nonzero only in that head's KV D-block.
+    q = q_ref[0].astype(jnp.float32) * scale                  # [R, D]
+    q_rep = jnp.concatenate([q] * KVH, axis=1)                # [R, F]
+    col_kv = jax.lax.broadcasted_iota(jnp.int32, (R, F), 1) // D
+    row_kv = (jax.lax.broadcasted_iota(jnp.int32, (R, F), 0) % H) // G
+    block_mask = (col_kv == row_kv).astype(jnp.float32)       # [R, F]
+    q2 = q_rep * block_mask
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = j % 2
+
+        @pl.when(j + 1 < n_pages)
+        def _():
+            for dma in page_dma((j + 1) % 2, j + 1):
+                dma.start()
+
+        for dma in page_dma(slot, j):
+            dma.wait()
+
+        k = k_buf[slot].astype(jnp.float32)                   # [bs, F]
+        v = v_buf[slot].astype(jnp.float32)
+        s_hb = jax.lax.dot_general(
+            q2, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [R, bs]
+        if soft_cap is not None:
+            s_hb = soft_cap * jnp.tanh(s_hb / soft_cap)
+        key_pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (1, bs), 1)                            # [1, bs]
+        valid = (key_pos <= q_pos) & (key_pos < seq_len)      # [R, bs]
+        s_hb = jnp.where(valid, s_hb, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_hb, axis=-1, keepdims=True))
+        p = jnp.exp(s_hb - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [R, F]
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((R, 1), -1e29, jnp.float32),
+        jnp.zeros((R, 1), jnp.float32),
+        jnp.zeros((R, F), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, init)
+    masked = acc * block_mask                                 # [R, F]
+    out = masked[:, 0:D]
+    for kk in range(1, KVH):
+        out = out + masked[:, kk * D:(kk + 1) * D]
+    out = out / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _pick_q_tile(Q: int, H: int, F: int) -> int:
+    """Largest q-tile whose f32 accumulator + query pair fits ~6 MB."""
+    qt = Q
+    while qt > 8 and qt * H * F * 8 > (6 << 20) and qt % 2 == 0:
+        qt //= 2
+    return qt
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "num_kv_heads", "scale",
+                              "soft_cap", "interpret", "q_tile"))
+def flash_prefill_paged(
+    qs: jax.Array,            # [S, Q, H, D] per-seq padded queries
+    q_pos: jax.Array,         # [S, Q] i32 absolute positions (pad -> -1)
+    k_cache: jax.Array,       # [L, num_slots, KVH*D] (or [num_slots, KVH*D])
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [S, B]
+    seq_lens: jax.Array,      # [S]
+    block_size: int,
+    num_kv_heads: int,
+    scale: float | None = None,
+    soft_cap: float | None = None,
+    layer: jax.Array | None = None,
+    interpret: bool = False,
+    q_tile: int | None = None,
+):
+    """Returns attention outputs [S, Q, H, D] (caches already written)."""
+    S, Q, H, D = qs.shape
+    scale = scale if scale is not None else D ** -0.5
+    squeeze = k_cache.ndim == 2
+    if squeeze:
+        k_cache = k_cache[None]
+        v_cache = v_cache[None]
+    F = k_cache.shape[2]
+    Qt = q_tile if q_tile is not None else _pick_q_tile(Q, H, F)
+    if Q % Qt:
+        raise ValueError(f"q_tile={Qt} must divide Q={Q}")
+    layer_arr = jnp.asarray([0 if layer is None else layer], jnp.int32)
+
+    # Fused row space (slot-major, head-minor), shaped OUTSIDE the kernel so
+    # Mosaic never sees a vector reshape.
+    q_fused = qs.reshape(S, Q * H, D)
+    qpos_fused = jnp.repeat(q_pos, H, axis=1)[..., None]      # [S, Q*H, 1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, Q // Qt),
+        in_specs=[
+            pl.BlockSpec((1, Qt * H, D), lambda s, t, *_: (s, t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Qt * H, 1), lambda s, t, *_: (s, t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Qt * H, D), lambda s, t, *_: (s, t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, F), k_cache.dtype),
+            pltpu.VMEM((2, block_size, F), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, block_size=block_size, num_heads=H,
+        num_kv_heads=num_kv_heads, scale=scale, soft_cap=soft_cap)
+    (out,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((S, Q * H, D), qs.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(block_tables, seq_lens, layer_arr, q_fused, qpos_fused,
+      k_cache, v_cache)
+    return out.reshape(S, Q, H, D)
