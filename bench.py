@@ -1,31 +1,38 @@
-"""Single-chip serving benchmark.
+"""Single-chip serving benchmark: dense + MoE, through the REAL engine path.
 
-Measures steady-state prefill and decode throughput of the flagship dense
-model through the REAL engine path (continuous batching, paged KV, on-device
-sampling) on whatever accelerator JAX exposes (one TPU chip under the
-driver).
+Two models run through the full engine (continuous batching, paged KV,
+on-device sampling, fused async decode) on whatever accelerator JAX exposes
+(one TPU chip under the driver):
 
-Methodology: a full warmup pass (identical shapes, disjoint token ids)
-compiles every bucket the timed pass will hit, so the numbers are
-steady-state throughput, not XLA compile time.  Extras report MFU and the
-decode HBM-roofline fraction so regressions are attributable.
+  - ``deepseek-v3-bench`` — the north-star proxy: DeepSeek-V3's serving
+    structure (MLA latent cache, sigmoid group-limited routing, shared
+    expert, top-8-of-64 routed experts, int8 expert weights) scaled to one
+    chip's HBM.  The headline metric is its best decode tok/s/chip, the
+    same axis as the reference's wide-EP headline (2,200 output tok/s/GPU,
+    DeepSeek-R1 on 32x H200 — BASELINE.md; /root/reference/README.md:20).
+  - ``llama3-1b`` — the dense regression canary tracked since round 1.
+
+Methodology: per model ONE engine is built; each batch size gets a full
+warmup pass (identical shapes, disjoint token ids) so every bucket and the
+fused multistep program are compiled before timing — steady-state numbers,
+not XLA compile time.  Extras carry MFU and HBM-roofline attribution per
+batch size so regressions are attributable.  A persistent compilation cache
+(``.jax_cache/``) makes repeat runs cheap.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": r}
-
-Baseline: 2,200 output tok/s/GPU — the reference's wide-EP H200 headline
-(BASELINE.md; README.md:20).  Not apples-to-apples yet (that number is
-DeepSeek-R1 on 32 chips; this is a 1B dense model on one chip) but it is the
-bar the driver tracks; the wide-EP bench replaces this as the MoE path
-matures.
+  {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": r,
+   "extras": {...}}
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
 import jax
+
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
 
 from llm_d_tpu.engine.engine import EngineConfig, EngineCore
 from llm_d_tpu.engine.request import Request
@@ -54,10 +61,39 @@ def _chip_spec(device) -> tuple:
     return (197e12, 819e9)
 
 
-def _param_bytes_and_count(params) -> tuple:
-    leaves = jax.tree.leaves(params)
-    return (sum(x.size * x.dtype.itemsize for x in leaves),
-            sum(x.size for x in leaves))
+def _param_bytes(params) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+
+def _active_param_count(c) -> int:
+    """Per-token *active* parameters (MoE: only routed-to experts count)."""
+    total = 0
+    dh = c.head_dim_
+    Lm = c.num_layers - c.first_dense_layers if c.is_moe else 0
+    Ld = c.num_layers - Lm
+    # Attention per layer.
+    if c.use_mla:
+        qh = c.qk_nope_head_dim + c.qk_rope_head_dim
+        attn = (c.hidden_size * c.q_lora_rank
+                + c.q_lora_rank * c.num_heads * qh
+                if c.q_lora_rank else c.hidden_size * c.num_heads * qh)
+        attn += c.hidden_size * (c.kv_lora_rank + c.qk_rope_head_dim)
+        attn += c.kv_lora_rank * c.num_heads * (c.qk_nope_head_dim
+                                                + c.v_head_dim)
+        attn += c.num_heads * c.v_head_dim * c.hidden_size
+    else:
+        attn = c.hidden_size * dh * (c.num_heads + 2 * c.num_kv_heads) \
+            + c.num_heads * dh * c.hidden_size
+    total += attn * c.num_layers
+    # Dense MLPs.
+    total += Ld * 3 * c.hidden_size * c.intermediate_size
+    # MoE layers: routed (k experts) + shared.
+    if c.is_moe:
+        per_expert = 3 * c.hidden_size * c.moe_intermediate_size
+        total += Lm * (c.num_experts_per_tok * per_expert
+                       + c.num_shared_experts * per_expert
+                       + c.hidden_size * c.num_experts)
+    return total
 
 
 def _run_workload(engine, reqs):
@@ -78,91 +114,128 @@ def _run_workload(engine, reqs):
     return t_prefill, t_decode, tokens_after - tokens_before
 
 
-def main() -> None:
-    n_seqs = 64
-    prompt_len = 128
-    decode_steps = 128
+def _make_reqs(tag, n, prompt_len, decode_steps, offset):
+    return [
+        Request(
+            request_id=f"{tag}-{i}",
+            prompt_token_ids=[(7 * i + 13 * j + offset) % 32000 + 1
+                              for j in range(prompt_len)],
+            sampling=SamplingParams(temperature=0.0,
+                                    max_tokens=decode_steps + 1,
+                                    ignore_eos=True),
+        )
+        for i in range(n)
+    ]
 
+
+def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
+                quantization=None):
+    """One engine, a workload per batch size (warmup + timed).  Returns
+    {bs: {prefill_tok_s, decode_tok_s, ...}} plus roofline attribution."""
+    max_bs = max(batch_sizes)
+    # KV sized to the workload + slack: the tunnel chip's usable HBM is
+    # well under the nominal 16 GB, so a fixed large pool OOMs the MoE run.
+    block_size = 64     # fewer, larger page DMAs (~2% over bs=32)
+    num_scheduler_steps = 32
+    blocks_per_seq = -(-(prompt_len + decode_steps + num_scheduler_steps + 1)
+                       // block_size)
     cfg = EngineConfig(
-        model="llama3-1b",
-        block_size=64,      # fewer, larger page DMAs (~2% over bs=32)
-        num_blocks=1024,
-        max_num_seqs=n_seqs,
+        model=model,
+        block_size=block_size,
+        num_blocks=max_bs * blocks_per_seq + block_size,
+        max_num_seqs=max_bs,
         max_num_batched_tokens=8192,
-        num_scheduler_steps=32,
+        num_scheduler_steps=num_scheduler_steps,
         async_scheduling=True,
         # Disjoint warmup/timed prompts must not share KV anyway; disabling
         # removes any chance the warmup pass warms more than the compiles.
         enable_prefix_caching=False,
+        quantization=quantization,
     )
     engine = EngineCore(cfg)
-
-    def make_reqs(tag: str, offset: int):
-        return [
-            Request(
-                request_id=f"{tag}-{i}",
-                prompt_token_ids=[(7 * i + 13 * j + offset) % 32000 + 1
-                                  for j in range(prompt_len)],
-                sampling=SamplingParams(temperature=0.0,
-                                        max_tokens=decode_steps + 1,
-                                        ignore_eos=True),
-            )
-            for i in range(n_seqs)
-        ]
-
-    # Warmup: identical shapes -> compiles every (T, S) bucket and the fused
-    # multistep program the timed pass uses.
-    _run_workload(engine, make_reqs("warm", 50000))
-
-    t_prefill, t_decode, decode_tokens = _run_workload(
-        engine, make_reqs("bench", 0))
-
-    prompt_tokens = n_seqs * prompt_len
-    prefill_tok_s = prompt_tokens / t_prefill
-    decode_tok_s = decode_tokens / t_decode
-
-    # --- MFU / roofline attribution ---
-    peak_flops, hbm_bw = _chip_spec(jax.devices()[0])
-    param_bytes, param_count = _param_bytes_and_count(engine.params)
     c = engine.model_config
-    # Embedding rows are gathered (no FLOPs); the lm_head matmul runs only
-    # for sampling rows — all prompt tokens in prefill share S head rows,
-    # while every decode token is a sampling row.
-    embed_params = c.vocab_size * c.hidden_size
-    head_params = 0 if c.tie_word_embeddings else embed_params
-    body_flops_per_token = 2 * (param_count - embed_params - head_params)
-    head_flops = 2 * embed_params   # lm_head matmul per sampled row
-    prefill_flops = body_flops_per_token * prompt_tokens \
-        + head_flops * n_seqs
-    prefill_mfu = prefill_flops / t_prefill / peak_flops
-    decode_mfu = decode_tok_s * (body_flops_per_token + head_flops) \
-        / peak_flops
-    # Decode is HBM-bound: each fused step reads the weights (embed table
-    # excluded: only S rows are gathered) plus each sequence's KV context.
-    avg_ctx = prompt_len + decode_steps // 2
-    kv_bytes_per_seq = 2 * c.num_layers * avg_ctx * c.num_kv_heads \
-        * c.head_dim_ * 2
-    embed_bytes = embed_params * 2
-    step_bytes = param_bytes - embed_bytes + n_seqs * kv_bytes_per_seq
-    roofline_tok_s = hbm_bw / step_bytes * n_seqs
-    decode_roofline_pct = decode_tok_s / roofline_tok_s
+    peak_flops, hbm_bw = _chip_spec(jax.devices()[0])
+    param_bytes = _param_bytes(engine.params)
+    embed_bytes = c.vocab_size * c.hidden_size * 2
+    active = _active_param_count(c)
+    head_flops = 2 * c.vocab_size * c.hidden_size
+    # Decode HBM roofline: each step reads every (quantized) weight byte
+    # except the embedding table (only S rows gathered) plus each
+    # sequence's KV context.  MoE note: at bs*k >= E every expert is
+    # touched every step, so the full expert set streams regardless of
+    # batch size — the wide-EP decode economics this bench exists to show.
+    layout = engine.model.kv_cache_layout(c)
+    kv_row = sum(layout.values()) * 2      # bytes/token/layer
 
-    result = {
-        "metric": "decode_output_tok_s_per_chip_llama1b_bs64",
-        "value": round(decode_tok_s, 1),
-        "unit": "tok/s/chip",
-        "vs_baseline": round(decode_tok_s / BASELINE_TOK_S_PER_CHIP, 3),
-        "extras": {
-            "backend": jax.default_backend(),
-            "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    out = {}
+    for bs in batch_sizes:
+        offset = 1000 * bs
+        _run_workload(engine, _make_reqs(
+            f"warm{bs}", bs, prompt_len, decode_steps, 50000 + offset))
+        t_prefill, t_decode, decode_tokens = _run_workload(
+            engine, _make_reqs(f"bench{bs}", bs, prompt_len, decode_steps,
+                               offset))
+        prompt_tokens = bs * prompt_len
+        prefill_tok_s = prompt_tokens / t_prefill
+        decode_tok_s = decode_tokens / t_decode
+
+        body_flops = 2 * active
+        prefill_mfu = (body_flops * prompt_tokens + head_flops * bs) \
+            / t_prefill / peak_flops
+        decode_mfu = decode_tok_s * (body_flops + head_flops) / peak_flops
+        avg_ctx = prompt_len + decode_steps // 2
+        step_bytes = (param_bytes - embed_bytes
+                      + bs * c.num_layers * avg_ctx * kv_row)
+        roofline_tok_s = hbm_bw / step_bytes * bs
+        out[bs] = {
             "prefill_tok_s": round(prefill_tok_s, 1),
-            "prefill_s_64x128": round(t_prefill, 3),
+            "decode_tok_s": round(decode_tok_s, 1),
             "prefill_mfu_pct": round(100 * prefill_mfu, 2),
             "decode_mfu_pct": round(100 * decode_mfu, 2),
-            "decode_hbm_roofline_pct": round(100 * decode_roofline_pct, 1),
-            "decode_steps": decode_steps,
-            "batch_size": n_seqs,
-        },
+            "decode_hbm_roofline_pct": round(
+                100 * decode_tok_s / roofline_tok_s, 1),
+            "decode_ms_per_step": round(1000 * t_decode / decode_steps, 2),
+        }
+    out["param_bytes"] = param_bytes
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="one batch size per model (dev loop)")
+    args = ap.parse_args()
+
+    moe_sizes = [256] if args.quick else [64, 256]
+    dense_sizes = [64] if args.quick else [64, 128, 256]
+
+    moe = bench_model("deepseek-v3-bench", moe_sizes, quantization="int8")
+    dense = bench_model("llama3-1b", dense_sizes)
+
+    best_bs = max(moe_sizes, key=lambda b: moe[b]["decode_tok_s"])
+    headline = moe[best_bs]["decode_tok_s"]
+
+    extras = {
+        "backend": jax.default_backend(),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        "moe_model": "deepseek-v3-bench (MLA + sigmoid top-8/64 + int8 "
+                     "experts, scaled DeepSeek-V3)",
+        "moe_batch_size": best_bs,
+        "decode_steps": 128,
+        "moe_param_gb": round(moe["param_bytes"] / 1e9, 2),
+        "moe_sweep": {str(b): moe[b] for b in moe_sizes},
+        "dense_model": "llama3-1b",
+        "dense_param_gb": round(dense["param_bytes"] / 1e9, 2),
+        "dense_sweep": {str(b): dense[b] for b in dense_sizes},
+        "decode_output_tok_s_per_chip_llama1b_bs64":
+            dense[64]["decode_tok_s"] if 64 in dense else None,
+    }
+    result = {
+        "metric": "decode_output_tok_s_per_chip_moe",
+        "value": headline,
+        "unit": "tok/s/chip",
+        "vs_baseline": round(headline / BASELINE_TOK_S_PER_CHIP, 3),
+        "extras": extras,
     }
     print(json.dumps(result))
 

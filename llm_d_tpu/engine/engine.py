@@ -135,6 +135,7 @@ class EngineCore:
         # --- device state ---
         self.model = get_model(c)       # models.llama (dense) or models.moe
         rules = self.model.sharding_rules(c)
+        owns_params = params is None
         if params is None:
             params = self.model.init_params(c, jax.random.PRNGKey(config.seed))
         if config.enable_dbo and not c.is_moe:
@@ -150,7 +151,10 @@ class EngineCore:
                     f"model {c.name!r} is dense")
             if "w_gate_q" not in params.get("moe_layers", {}):
                 from llm_d_tpu.ops.quant import quantize_moe_experts
-                params = quantize_moe_experts(params)
+                # Donation (halved peak HBM) only for self-initialized
+                # params: donating caller-provided arrays would invalidate
+                # buffers the caller may still use.
+                params = quantize_moe_experts(params, donate=owns_params)
         elif config.quantization is not None:
             raise ValueError(f"unknown quantization {config.quantization!r}")
         shardings = logical_to_sharding(rules, params, self.mesh)

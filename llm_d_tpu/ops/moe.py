@@ -148,6 +148,44 @@ def _local_expert_ffn(
     return out
 
 
+def _dense_expert_ffn(
+    x: jax.Array,          # [T, H]
+    weights: jax.Array,    # [T, k] combine weights
+    idx: jax.Array,        # [T, k] expert ids
+    w_gate: jax.Array,     # [E, H, I]
+    w_up: jax.Array,
+    w_down: jax.Array,     # [E, I, H]
+) -> jax.Array:            # [T, H] f32
+    """All-experts batched GEMM with masked combine — the decode path.
+
+    Rationale (measured on v5e): decode batches are tiny, so the MoE FFN is
+    HBM-bound on expert weights with ~100x MXU headroom.  ``ragged_dot``
+    with E groups of ~T*k/E rows streams weights at ~260 GB/s here (tile
+    padding + per-group pipeline bubbles); one batched einsum over ALL
+    experts streams at ~700 GB/s — 2.7x faster despite computing E/k times
+    the FLOPs — and stays ahead through T=512.  The combine weight is
+    pre-scaled onto the activations so unrouted (token, expert) pairs
+    contribute exactly zero; int8 weights dequantize inside the einsum
+    operand read (no materialized bf16 copy).
+    """
+    T = x.shape[0]
+    E = w_gate.shape[0]
+    comb = jnp.zeros((T, E), jnp.float32).at[
+        jnp.arange(T)[:, None], idx].add(weights)            # [T, E]
+    h = jnp.einsum("th,ehi->eti", x, w_gate,
+                   preferred_element_type=jnp.float32)
+    u = jnp.einsum("th,ehi->eti", x, w_up,
+                   preferred_element_type=jnp.float32)
+    a = (jax.nn.silu(h) * u * comb.T[:, :, None]).astype(x.dtype)
+    return jnp.einsum("eti,eih->th", a, w_down,
+                      preferred_element_type=jnp.float32)
+
+
+# Below this many tokens the dense all-experts path beats ragged_dot on a
+# single shard (measured crossover on v5e; see _dense_expert_ffn).
+DENSE_DISPATCH_MAX_T = 512
+
+
 def _excl_cumsum(v: jax.Array) -> jax.Array:
     return jnp.concatenate([jnp.zeros(1, v.dtype), jnp.cumsum(v)[:-1]])
 
@@ -326,18 +364,30 @@ def expert_ffn(
     w_up: jax.Array,
     w_down: jax.Array,     # [E, I, H]
     mesh: Optional[Mesh] = None,
-    dispatch: str = "auto",   # auto | a2a | psum
+    dispatch: str = "auto",   # auto | a2a | psum | dense | ragged
     dbo_min_tokens: Optional[int] = None,   # DBO: force >= 2 chunks at this T
 ) -> jax.Array:            # [T, H] in x.dtype
     """Routed-expert FFN, expert-parallel over the flattened mesh.
 
-    Single-device: one grouped GEMM over all experts.  Multi-device:
-    sparse all-to-all dispatch by default (``LLMD_MOE_DISPATCH=psum``
-    forces the oracle path; see module docstring).
+    Single-device: dense all-experts batched GEMM below
+    ``DENSE_DISPATCH_MAX_T`` tokens (decode regime — see
+    ``_dense_expert_ffn``), sorted grouped GEMM above it (prefill).
+    Multi-device: sparse all-to-all dispatch by default
+    (``LLMD_MOE_DISPATCH=psum`` forces the oracle path; see module
+    docstring).
     """
     if mesh is None or mesh.devices.size == 1:
-        out = _local_expert_ffn(
-            x, weights, idx, w_gate, w_up, w_down, jnp.int32(0))
+        if dispatch == "auto":
+            dispatch = os.environ.get("LLMD_MOE_DISPATCH", "auto")
+        if dispatch == "auto":
+            max_t = int(os.environ.get("LLMD_MOE_DENSE_MAX_T",
+                                       str(DENSE_DISPATCH_MAX_T)))
+            dispatch = "dense" if x.shape[0] <= max_t else "ragged"
+        if dispatch == "dense":
+            out = _dense_expert_ffn(x, weights, idx, w_gate, w_up, w_down)
+        else:
+            out = _local_expert_ffn(
+                x, weights, idx, w_gate, w_up, w_down, jnp.int32(0))
         return out.astype(x.dtype)
 
     E = w_gate.shape[0]
@@ -345,6 +395,11 @@ def expert_ffn(
     E_loc = E // ep
     if dispatch == "auto":
         dispatch = os.environ.get("LLMD_MOE_DISPATCH", "auto")
+    if dispatch in ("dense", "ragged"):
+        # Single-device-only modes must not silently run the psum oracle.
+        raise ValueError(
+            f"dispatch={dispatch!r} is single-device only; use 'a2a' or "
+            f"'psum' on a {ep}-device mesh")
     if dispatch == "auto":
         dispatch = "a2a" if (x.shape[0] % ep == 0 and E % ep == 0) else "psum"
     if dispatch == "a2a":

@@ -35,22 +35,35 @@ def quantize_int8(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return q, scale.astype(jnp.float32)
 
 
+# Jitted: eagerly, the quantize chain materializes several full-size f32
+# temporaries (4 GB each at bench scale) that OOM the chip; under jit the
+# elementwise chain fuses into the int8 write.  The donating variant also
+# retires the bf16 original at entry — only safe when the caller owns the
+# buffers (engine-initialized params, not caller-provided ones).
+_quantize_int8_jit = jax.jit(quantize_int8)
+_quantize_int8_donate = jax.jit(quantize_int8, donate_argnums=(0,))
+
+
 def dequantize(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
-def quantize_moe_experts(params: Dict[str, Any]) -> Dict[str, Any]:
+def quantize_moe_experts(params: Dict[str, Any],
+                         donate: bool = False) -> Dict[str, Any]:
     """Replace moe_layers expert weights with int8 payload + scale pairs.
 
     ``w_gate [L,E,H,I]`` -> ``w_gate_q`` int8 + ``w_gate_s`` f32 [L,E,1,I].
     The EP sharding rules match the ``w_gate``/``w_up``/``w_down`` prefixes,
     so the quantized tensors shard over experts exactly like the originals.
+    ``donate=True`` frees each bf16 original as it converts (halves peak
+    HBM) — callers must own the arrays (donated buffers are invalidated).
     """
+    quantize = _quantize_int8_donate if donate else _quantize_int8_jit
     ml = dict(params["moe_layers"])
     for name in EXPERT_WEIGHT_KEYS:
         if name not in ml:
             continue
-        q, s = quantize_int8(ml.pop(name))
+        q, s = quantize(ml.pop(name))
         ml[f"{name}_q"] = q
         ml[f"{name}_s"] = s
     out = dict(params)
@@ -59,11 +72,19 @@ def quantize_moe_experts(params: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def expert_weights(lp: Dict[str, Any], dtype=jnp.bfloat16):
-    """(w_gate, w_up, w_down) from a (possibly quantized) layer slice."""
+    """(w_gate, w_up, w_down) from a (possibly quantized) layer slice.
+
+    The int8 payload is passed through ``optimization_barrier`` before the
+    dequant: without it XLA rewrites ``convert(dynamic_slice(W))`` into
+    ``dynamic_slice(convert(W))`` under the layer scan and materializes the
+    WHOLE expert stack in bf16 — +2x the int8 model's weight footprint,
+    which is exactly the memory the quantization exists to save (observed
+    as an OOM on v5e with the deepseek-v3-bench config)."""
     out = []
     for name in EXPERT_WEIGHT_KEYS:
         if name in lp:
             out.append(lp[name])
         else:
-            out.append(dequantize(lp[f"{name}_q"], lp[f"{name}_s"], dtype))
+            q = jax.lax.optimization_barrier(lp[f"{name}_q"])
+            out.append(dequantize(q, lp[f"{name}_s"], dtype))
     return tuple(out)

@@ -173,6 +173,13 @@ def test_expert_ffn_matches_dense_dispatch(T, E, k):
     got = moe_ops.expert_ffn(x, weights, idx, wg, wu, wd)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+    # Both single-device dispatch modes must match the oracle (auto picks
+    # dense below DENSE_DISPATCH_MAX_T and ragged above; pin each).
+    for mode in ("dense", "ragged"):
+        got_m = moe_ops.expert_ffn(x, weights, idx, wg, wu, wd,
+                                   dispatch=mode)
+        np.testing.assert_allclose(np.asarray(got_m), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
 
 
 # ---------- engine vs dense-math oracle ----------
