@@ -42,17 +42,32 @@ class EndpointState:
 class Datastore:
     def __init__(self, endpoints: List[EndpointState],
                  scrape_interval_s: float = 0.2,
-                 kv_usage_metric: str = "vllm:kv_cache_usage_perc") -> None:
+                 kv_usage_metric: str = "vllm:kv_cache_usage_perc",
+                 resolver=None,
+                 resolve_interval_s: float = 1.0) -> None:
+        """``resolver`` (see ``epp.discovery``) makes the endpoint set
+        dynamic: each resolve tick reconciles joins/leaves while surviving
+        endpoints keep their scraped state.  Static ``endpoints`` and a
+        resolver may coexist (static entries never leave)."""
         self.endpoints: Dict[str, EndpointState] = {
             e.address: e for e in endpoints}
+        self._static = set(self.endpoints)
         self.scrape_interval_s = scrape_interval_s
         self.kv_usage_metric = kv_usage_metric
+        self.resolver = resolver
+        self.resolve_interval_s = resolve_interval_s
         self._task: Optional[asyncio.Task] = None
+        self._resolve_task: Optional[asyncio.Task] = None
         self._session: Optional[aiohttp.ClientSession] = None
+        # Leave hooks (e.g. the gateway drops a pod's prefix-index entries).
+        self.on_remove = []
 
     def candidates(self, role: Optional[str] = None) -> List[EndpointState]:
         out = []
-        for e in self.endpoints.values():
+        # Snapshot: discovery reconciles this dict on the event loop while
+        # the scheduler iterates from a worker thread (service.py runs
+        # schedule() via asyncio.to_thread).
+        for e in list(self.endpoints.values()):
             if role and e.role not in (role, "both"):
                 continue
             out.append(e)
@@ -63,15 +78,23 @@ class Datastore:
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=2.0))
-        self._task = asyncio.get_running_loop().create_task(self._loop())
+        loop = asyncio.get_running_loop()
+        if self.resolver is not None:
+            # First resolve before the first scrape: a gateway started
+            # against an empty static list becomes routable as soon as
+            # discovery returns.
+            await self.resolve_once()
+            self._resolve_task = loop.create_task(self._resolve_loop())
+        self._task = loop.create_task(self._loop())
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+        for t in (self._task, self._resolve_task):
+            if t:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
         if self._session:
             await self._session.close()
 
@@ -79,6 +102,57 @@ class Datastore:
         while True:
             await self.scrape_once()
             await asyncio.sleep(self.scrape_interval_s)
+
+    # ---------- discovery ----------
+
+    async def _resolve_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.resolve_interval_s)
+            try:
+                await self.resolve_once()
+            except Exception as exc:   # discovery outage != gateway outage
+                logger.warning("endpoint resolve failed: %s", exc)
+
+    async def resolve_once(self) -> None:
+        resolved = await self.resolver.resolve()
+        self.reconcile(resolved)
+
+    def reconcile(self, resolved) -> None:
+        """Apply a resolved [(address, role)] set: add joins, drop leaves.
+
+        Surviving endpoints keep their EndpointState object (scrape history,
+        readiness); static CLI endpoints are never dropped.  An EMPTY
+        resolve result is treated as a discovery outage, not a scale-to-
+        zero: both resolvers degrade to [] on DNS/API errors, and acting on
+        one transient timeout would drop every endpoint AND fire the
+        on_remove hooks that wipe the prefix index — state that takes
+        minutes of traffic to re-warm.  (True scale-to-zero is safe under
+        this policy too: the vanished pods just fail their scrapes and stop
+        being candidates.)
+        """
+        if not resolved and any(a not in self._static
+                                for a in self.endpoints):
+            logger.warning(
+                "resolver returned no endpoints; keeping current set "
+                "(discovery outage policy)")
+            return
+        seen = set()
+        for address, role in resolved:
+            seen.add(address)
+            cur = self.endpoints.get(address)
+            if cur is None:
+                self.endpoints[address] = EndpointState(
+                    address=address, role=role)
+                logger.info("endpoint joined: %s (%s)", address, role)
+            elif cur.role != role and address not in self._static:
+                cur.role = role
+        for address in list(self.endpoints):
+            if address in seen or address in self._static:
+                continue
+            del self.endpoints[address]
+            logger.info("endpoint left: %s", address)
+            for hook in self.on_remove:
+                hook(address)
 
     async def scrape_once(self) -> None:
         await asyncio.gather(

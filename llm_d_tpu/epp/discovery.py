@@ -1,0 +1,202 @@
+"""Dynamic endpoint discovery for the EPP (the InferencePool/GAIE role).
+
+The reference EPP never sees a static endpoint list: it watches an
+``InferencePool`` selector and scores/routes per POD, with Envoy
+ORIGINAL_DST delivering to the exact address it picked (reference:
+guides/standalone-inference-scheduling/values.yaml:170-181,
+inference-scheduling/helmfile.yaml.gotmpl:62-65).  Per-pod identity is
+load-bearing: queue/KV-util scraping, prefix affinity, and the WVA
+autoscaler all assume the scheduler can see replicas come and go.
+
+Three resolvers cover the deployment spectrum:
+
+  - ``StaticResolver``  — fixed ``host:port[=role]`` list (dev / tests).
+  - ``DnsResolver``     — polls DNS A records of a *headless* Service
+                          (``clusterIP: None``), where kube-dns returns one
+                          record per ready pod.  No API-server credentials
+                          needed; the fallback path for any cluster.
+  - ``K8sEndpointSliceResolver`` — reads ``discovery.k8s.io/v1``
+                          EndpointSlices for a Service via the in-cluster
+                          API (serviceaccount token), the same object
+                          stream the reference's InferencePool controller
+                          consumes.  Picks up `ready` conditions, so
+                          unready pods leave the candidate set before they
+                          black-hole requests.
+
+The Datastore reconciles each resolve tick: surviving addresses keep their
+scraped state (prefix-affinity continuity), new ones join as not-ready
+until their first successful ``/metrics`` scrape, vanished ones drop out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+import ssl
+from typing import List, Optional, Sequence, Tuple
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+# (address "host:port", role "prefill"|"decode"|"both")
+Resolved = Tuple[str, str]
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class StaticResolver:
+    """Fixed endpoint list (the dev/test path; no discovery)."""
+
+    def __init__(self, endpoints: Sequence[Resolved]) -> None:
+        self._endpoints = list(endpoints)
+
+    async def resolve(self) -> List[Resolved]:
+        return list(self._endpoints)
+
+
+class DnsResolver:
+    """Poll DNS A records of a headless Service: one record per ready pod."""
+
+    def __init__(self, name: str, port: int, role: str = "both") -> None:
+        self.name = name
+        self.port = port
+        self.role = role
+
+    async def resolve(self) -> List[Resolved]:
+        loop = asyncio.get_running_loop()
+        try:
+            infos = await loop.getaddrinfo(self.name, self.port,
+                                           type=socket.SOCK_STREAM)
+        except OSError as exc:
+            logger.warning("dns resolve %s failed: %s", self.name, exc)
+            return []
+        hosts = {info[4][0] for info in infos}
+        # Bracket IPv6 hosts so "host:port" splits unambiguously.
+        addrs = sorted(
+            f"[{h}]:{self.port}" if ":" in h else f"{h}:{self.port}"
+            for h in hosts)
+        return [(a, self.role) for a in addrs]
+
+
+class K8sEndpointSliceResolver:
+    """List EndpointSlices for a Service through the Kubernetes API.
+
+    Uses the pod's mounted serviceaccount credentials; ``api_server`` /
+    ``token`` / ``ca_file`` are injectable so tests can point it at a fake
+    API server.  Only addresses whose endpoint reports ``conditions.ready``
+    (or leaves it unset, which the API defines as ready) are returned.
+    """
+
+    def __init__(self, service: str, port: int,
+                 namespace: Optional[str] = None,
+                 role: str = "both",
+                 api_server: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None) -> None:
+        self.service = service
+        self.port = port
+        # In-cluster convention: default to the pod's OWN namespace (the
+        # RBAC in gateway.yaml is namespaced; querying "default" from any
+        # other namespace would 403 and silently disable k8s discovery).
+        if namespace is None:
+            namespace = "default"
+            if os.path.exists(f"{_SA_DIR}/namespace"):
+                with open(f"{_SA_DIR}/namespace") as f:
+                    namespace = f.read().strip() or "default"
+        self.namespace = namespace
+        self.role = role
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        kport = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.api_server = api_server or (
+            f"https://{host}:{kport}" if host else None)
+        self._token = token
+        self._ca_file = ca_file if ca_file is not None else (
+            f"{_SA_DIR}/ca.crt" if os.path.exists(f"{_SA_DIR}/ca.crt")
+            else None)
+
+    def _auth_headers(self) -> dict:
+        token = self._token
+        if token is None and os.path.exists(f"{_SA_DIR}/token"):
+            with open(f"{_SA_DIR}/token") as f:
+                token = f.read().strip()
+        return {"Authorization": f"Bearer {token}"} if token else {}
+
+    async def resolve(self) -> List[Resolved]:
+        if not self.api_server:
+            logger.warning("k8s resolver: no API server (not in-cluster?)")
+            return []
+        url = (f"{self.api_server}/apis/discovery.k8s.io/v1/namespaces/"
+               f"{self.namespace}/endpointslices"
+               f"?labelSelector=kubernetes.io/service-name={self.service}")
+        sslctx = None
+        if self._ca_file:
+            sslctx = ssl.create_default_context(cafile=self._ca_file)
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=5)) as sess:
+                async with sess.get(url, headers=self._auth_headers(),
+                                    ssl=sslctx) as resp:
+                    resp.raise_for_status()
+                    body = await resp.json()
+        except Exception as exc:
+            logger.warning("k8s endpointslice list failed: %s", exc)
+            return []
+        addrs = set()
+        for es in body.get("items", []):
+            for ep in es.get("endpoints", []):
+                ready = ep.get("conditions", {}).get("ready")
+                if ready is False:      # unset counts as ready (API spec)
+                    continue
+                for a in ep.get("addresses", []):
+                    addrs.add(f"{a}:{self.port}")
+        return [(a, self.role) for a in sorted(addrs)]
+
+
+class MultiResolver:
+    """Union of several resolvers (e.g. separate prefill/decode Services)."""
+
+    def __init__(self, resolvers: Sequence) -> None:
+        self.resolvers = list(resolvers)
+
+    async def resolve(self) -> List[Resolved]:
+        results = await asyncio.gather(
+            *(r.resolve() for r in self.resolvers), return_exceptions=True)
+        out: List[Resolved] = []
+        for r in results:
+            if isinstance(r, BaseException):
+                logger.warning("resolver failed: %s", r)
+                continue
+            out.extend(r)
+        return out
+
+
+def parse_discover_spec(spec: str):
+    """One ``--discover`` item -> resolver.
+
+    Forms (role defaults to ``both``):
+      ``dns:<name>:<port>[=role]``
+      ``k8s:<namespace>/<service>:<port>[=role]``
+    """
+    role = "both"
+    if "=" in spec:
+        spec, role = spec.rsplit("=", 1)
+    kind, _, rest = spec.partition(":")
+    if kind == "dns":
+        name, _, port = rest.rpartition(":")
+        if not name:
+            raise ValueError(f"--discover dns needs <name>:<port>: {spec!r}")
+        return DnsResolver(name, int(port), role=role)
+    if kind == "k8s":
+        nsvc, _, port = rest.rpartition(":")
+        ns, _, svc = nsvc.partition("/")
+        if not svc:
+            ns, svc = None, ns      # no namespace -> the pod's own
+        if not svc:
+            raise ValueError(
+                f"--discover k8s needs [<ns>/]<service>:<port>: {spec!r}")
+        return K8sEndpointSliceResolver(svc, int(port), namespace=ns,
+                                        role=role)
+    raise ValueError(f"unknown --discover kind {kind!r} (dns|k8s)")

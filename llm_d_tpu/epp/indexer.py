@@ -83,6 +83,12 @@ class PrefixIndex:
                     self._hits / self._queries)
         return n
 
+    def remove_endpoint(self, endpoint: str) -> None:
+        """Drop every entry owned by a departed endpoint (discovery leave):
+        stale ownership would keep pulling prefix-affine traffic toward a
+        pod that no longer exists."""
+        self.on_event(endpoint, "AllBlocksCleared", ())
+
     @property
     def size(self) -> int:
         with self._lock:

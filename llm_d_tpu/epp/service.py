@@ -47,12 +47,60 @@ def parse_endpoint_arg(arg: str) -> EndpointState:
     return EndpointState(address=arg, role=role)
 
 
+class FlowControl:
+    """Bounded admission (the reference's flow-control queue,
+    example-promQL-queries.md:40-80): at most ``max_inflight`` requests hold
+    an upstream slot; excess waits in a bounded FIFO up to
+    ``queue_timeout_s``.  Under saturation the gateway degrades to bounded
+    latency + fast rejection instead of fanning unbounded concurrency at
+    the model servers.  Sheddable requests (priority < 0) never queue —
+    they 429 immediately, consistent with SLO shedding."""
+
+    def __init__(self, max_inflight: int, max_queue: int,
+                 queue_timeout_s: float, metrics) -> None:
+        self._sem = asyncio.Semaphore(max_inflight)
+        self.max_queue = max_queue
+        self.queue_timeout_s = queue_timeout_s
+        self._queued = 0
+        self.metrics = metrics
+
+    async def acquire(self, sheddable: bool) -> str:
+        """Returns "ok" (slot held), "saturated" (sheddable, no slot),
+        "queue_full", or "timeout"."""
+        if not self._sem.locked():
+            await self._sem.acquire()
+            return "ok"
+        if sheddable:
+            return "saturated"
+        if self._queued >= self.max_queue:
+            self.metrics.flow_control_rejects.labels(
+                reason="queue_full").inc()
+            return "queue_full"
+        self._queued += 1
+        self.metrics.flow_control_queue.set(self._queued)
+        try:
+            await asyncio.wait_for(self._sem.acquire(),
+                                   self.queue_timeout_s)
+            return "ok"
+        except asyncio.TimeoutError:
+            self.metrics.flow_control_rejects.labels(reason="timeout").inc()
+            return "timeout"
+        finally:
+            self._queued -= 1
+            self.metrics.flow_control_queue.set(self._queued)
+
+    def release(self) -> None:
+        self._sem.release()
+
+
 class Gateway:
     def __init__(self, scheduler: EppScheduler, datastore: Datastore,
-                 subscriber: Optional[ZmqEventSubscriber] = None) -> None:
+                 subscriber: Optional[ZmqEventSubscriber] = None,
+                 flow: Optional[FlowControl] = None) -> None:
         self.scheduler = scheduler
         self.datastore = datastore
         self.subscriber = subscriber
+        self.flow = flow
         self._session: Optional[aiohttp.ClientSession] = None
 
     def build_app(self) -> web.Application:
@@ -107,6 +155,33 @@ class Gateway:
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
 
+        try:
+            priority = int(body.get("priority") or 0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "invalid request: priority must be an int"},
+                status=400)
+        if self.flow is None:
+            return await self._schedule_and_forward(body, request)
+        outcome = await self.flow.acquire(sheddable=priority < 0)
+        if outcome == "saturated":
+            self.flow.metrics.flow_control_rejects.labels(
+                reason="saturated").inc()
+            return web.json_response(
+                {"error": "saturated: sheddable request refused under "
+                          "load"}, status=429)
+        if outcome in ("queue_full", "timeout"):
+            return web.json_response(
+                {"error": f"overloaded: flow control {outcome}"},
+                status=503)
+        try:
+            return await self._schedule_and_forward(body, request)
+        finally:
+            self.flow.release()
+
+    async def _schedule_and_forward(self, body: Dict,
+                                    request: web.Request
+                                    ) -> web.StreamResponse:
         try:
             ctx = self._make_ctx(body, request)
             # Scoring may block (prediction-sidecar HTTP, lock contention):
@@ -190,9 +265,16 @@ def build_gateway(
     scrape_interval_s: float = 0.2,
     kv_events_bind: Optional[str] = None,
     indexer: Optional[PrefixIndex] = None,
+    resolver=None,
+    resolve_interval_s: float = 1.0,
+    max_inflight: int = 256,
+    max_queue: int = 128,
+    queue_timeout_s: float = 30.0,
 ) -> Gateway:
     config = parse_config(config_yaml or DEFAULT_CONFIG_YAML)
-    datastore = Datastore(endpoints, scrape_interval_s=scrape_interval_s)
+    datastore = Datastore(endpoints, scrape_interval_s=scrape_interval_s,
+                          resolver=resolver,
+                          resolve_interval_s=resolve_interval_s)
     metrics = EppMetrics()
     needs_index = any(p.type == "precise-prefix-cache-scorer"
                       for p in config.plugins)
@@ -201,16 +283,27 @@ def build_gateway(
         indexer = PrefixIndex(metrics=metrics)
     if indexer is not None and kv_events_bind:
         subscriber = ZmqEventSubscriber(indexer, bind=kv_events_bind)
+    if indexer is not None:
+        # Discovery leave -> drop the pod's prefix-index ownership.
+        datastore.on_remove.append(indexer.remove_endpoint)
     scheduler = EppScheduler(config, datastore, metrics=metrics,
                              indexer=indexer)
-    return Gateway(scheduler, datastore, subscriber=subscriber)
+    flow = (FlowControl(max_inflight, max_queue, queue_timeout_s, metrics)
+            if max_inflight > 0 else None)
+    return Gateway(scheduler, datastore, subscriber=subscriber, flow=flow)
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser("llmd-gateway")
-    p.add_argument("--endpoints", required=True,
-                   help="comma list of host:port[=role]; role in "
+    p.add_argument("--endpoints", default="",
+                   help="comma list of static host:port[=role]; role in "
                         "prefill|decode|both")
+    p.add_argument("--discover", default="",
+                   help="comma list of discovery specs: "
+                        "dns:<headless-svc>:<port>[=role] | "
+                        "k8s:[<ns>/]<service>:<port>[=role] "
+                        "(per-pod endpoints join/leave live)")
+    p.add_argument("--resolve-interval", type=float, default=1.0)
     p.add_argument("--config", default=None,
                    help="EndpointPickerConfig YAML path (default: queue + "
                         "kv-util + prefix scorers, max-score picker)")
@@ -220,6 +313,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--kv-events-bind", default=None,
                    help="ZMQ bind for engine KV events, e.g. tcp://*:5557 "
                         "(enables the precise prefix index)")
+    p.add_argument("--max-inflight", type=int, default=256,
+                   help="flow control: concurrent upstream requests "
+                        "(0 disables flow control)")
+    p.add_argument("--max-queue", type=int, default=128,
+                   help="flow control: waiting-queue depth before 503")
+    p.add_argument("--queue-timeout", type=float, default=30.0,
+                   help="flow control: max seconds a request may queue")
     args = p.parse_args(argv)
 
     config_yaml = None
@@ -228,9 +328,23 @@ def main(argv: Optional[List[str]] = None) -> None:
             config_yaml = f.read()
     endpoints = [parse_endpoint_arg(e)
                  for e in args.endpoints.split(",") if e.strip()]
+    resolver = None
+    specs = [s for s in args.discover.split(",") if s.strip()]
+    if specs:
+        from llm_d_tpu.epp.discovery import MultiResolver, parse_discover_spec
+        resolvers = [parse_discover_spec(s.strip()) for s in specs]
+        resolver = resolvers[0] if len(resolvers) == 1 \
+            else MultiResolver(resolvers)
+    if not endpoints and resolver is None:
+        p.error("need --endpoints and/or --discover")
     gw = build_gateway(endpoints, config_yaml,
                        scrape_interval_s=args.scrape_interval,
-                       kv_events_bind=args.kv_events_bind)
+                       kv_events_bind=args.kv_events_bind,
+                       resolver=resolver,
+                       resolve_interval_s=args.resolve_interval,
+                       max_inflight=args.max_inflight,
+                       max_queue=args.max_queue,
+                       queue_timeout_s=args.queue_timeout)
     logging.basicConfig(level=logging.INFO)
     web.run_app(gw.build_app(), host=args.host, port=args.port)
 

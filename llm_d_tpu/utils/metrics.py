@@ -131,6 +131,10 @@ class EppMetrics:
         self.flow_control_queue = Gauge(
             "inference_extension_flow_control_queue_size",
             "Requests held by gateway flow control.", registry=self.registry)
+        self.flow_control_rejects = Counter(
+            "inference_extension_flow_control_rejects_total",
+            "Requests rejected by gateway flow control.", ["reason"],
+            registry=self.registry)
         self.requests_total = Counter(
             "inference_objective_request_total",
             "Requests scheduled.", ["target"], registry=self.registry)

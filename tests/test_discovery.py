@@ -1,0 +1,257 @@
+"""Dynamic endpoint discovery: resolvers, datastore reconciliation, and the
+VERDICT r3 'done' bar — sim replicas added/removed at runtime with
+prefix-affinity routing following them (reference: the InferencePool/GAIE
+per-pod watch, standalone-inference-scheduling/values.yaml:170-181)."""
+
+import asyncio
+
+import pytest
+
+from llm_d_tpu.epp.datastore import Datastore, EndpointState
+from llm_d_tpu.epp.discovery import (
+    DnsResolver, K8sEndpointSliceResolver, MultiResolver, StaticResolver,
+    parse_discover_spec)
+from llm_d_tpu.epp.scheduler import DESTINATION_HEADER
+
+
+def free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _start_app(app, port):
+    from aiohttp import web
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner
+
+
+# ---------- spec parsing ----------
+
+def test_parse_discover_specs():
+    r = parse_discover_spec("dns:ms-decode:8200")
+    assert isinstance(r, DnsResolver)
+    assert (r.name, r.port, r.role) == ("ms-decode", 8200, "both")
+
+    r = parse_discover_spec("dns:ms-prefill:8200=prefill")
+    assert r.role == "prefill"
+
+    r = parse_discover_spec("k8s:prod/ms-decode:8200=decode")
+    assert isinstance(r, K8sEndpointSliceResolver)
+    assert (r.service, r.namespace, r.port, r.role) == (
+        "ms-decode", "prod", 8200, "decode")
+
+    r = parse_discover_spec("k8s:ms-x:9000")
+    assert (r.service, r.namespace) == ("ms-x", "default")
+
+    with pytest.raises(ValueError):
+        parse_discover_spec("zk:nope:1")
+
+
+# ---------- resolvers ----------
+
+def test_dns_resolver_localhost():
+    async def run():
+        res = await DnsResolver("localhost", 8200, role="decode").resolve()
+        assert ("127.0.0.1:8200", "decode") in res
+
+        # Unresolvable names degrade to empty (outage != crash).
+        assert await DnsResolver(
+            "no-such-host.invalid", 1).resolve() == []
+
+    asyncio.run(run())
+
+
+def test_k8s_endpointslice_resolver_fake_api():
+    """Points the resolver at a fake API server speaking discovery.k8s.io/v1;
+    asserts label selector, bearer auth, and the ready-condition filter."""
+    from aiohttp import web
+
+    seen = {}
+
+    async def endpointslices(request):
+        seen["selector"] = request.query.get("labelSelector")
+        seen["auth"] = request.headers.get("Authorization")
+        return web.json_response({"items": [
+            {"endpoints": [
+                {"addresses": ["10.0.0.1"],
+                 "conditions": {"ready": True}},
+                {"addresses": ["10.0.0.2"],
+                 "conditions": {"ready": False}},     # filtered
+                {"addresses": ["10.0.0.3"]},          # unset = ready
+            ]},
+            {"endpoints": [
+                {"addresses": ["10.0.0.4"], "conditions": {}},
+            ]},
+        ]})
+
+    async def run():
+        app = web.Application()
+        app.router.add_get(
+            "/apis/discovery.k8s.io/v1/namespaces/prod/endpointslices",
+            endpointslices)
+        port = free_port()
+        runner = await _start_app(app, port)
+        try:
+            r = K8sEndpointSliceResolver(
+                "ms-decode", 8200, namespace="prod", role="decode",
+                api_server=f"http://127.0.0.1:{port}", token="tok",
+                ca_file="")
+            res = await r.resolve()
+        finally:
+            await runner.cleanup()
+        assert seen["selector"] == "kubernetes.io/service-name=ms-decode"
+        assert seen["auth"] == "Bearer tok"
+        assert res == [("10.0.0.1:8200", "decode"),
+                       ("10.0.0.3:8200", "decode"),
+                       ("10.0.0.4:8200", "decode")]
+
+        # No API server configured (not in-cluster): empty, not a crash.
+        assert await K8sEndpointSliceResolver(
+            "x", 1, api_server=None).resolve() == []
+
+    asyncio.run(run())
+
+
+# ---------- datastore reconciliation ----------
+
+def test_datastore_reconcile_join_leave():
+    ds = Datastore([EndpointState(address="10.0.0.9:1=static".split("=")[0],
+                                  role="both")],
+                   scrape_interval_s=999)
+    removed = []
+    ds.on_remove.append(removed.append)
+
+    ds.reconcile([("10.0.0.1:8200", "decode"), ("10.0.0.2:8200", "decode")])
+    assert set(ds.endpoints) == {"10.0.0.9:1", "10.0.0.1:8200",
+                                 "10.0.0.2:8200"}
+    # Surviving endpoints keep their state object (scrape continuity).
+    e1 = ds.endpoints["10.0.0.1:8200"]
+    e1.ready = True
+    e1.num_waiting = 7
+
+    ds.reconcile([("10.0.0.1:8200", "decode"), ("10.0.0.3:8200", "decode")])
+    assert ds.endpoints["10.0.0.1:8200"] is e1
+    assert e1.num_waiting == 7
+    assert "10.0.0.2:8200" not in ds.endpoints
+    assert removed == ["10.0.0.2:8200"]
+    # Static CLI endpoints never leave.
+    assert "10.0.0.9:1" in ds.endpoints
+
+    # Empty resolve = discovery outage: endpoint set (and prefix-index
+    # ownership) survives; the next good resolve reconciles normally.
+    ds.reconcile([])
+    assert "10.0.0.1:8200" in ds.endpoints and removed == ["10.0.0.2:8200"]
+    ds.reconcile([("10.0.0.3:8200", "decode")])
+    assert "10.0.0.1:8200" not in ds.endpoints
+
+
+def test_multi_resolver_union_and_failure_isolation():
+    class Boom:
+        async def resolve(self):
+            raise RuntimeError("api down")
+
+    async def run():
+        r = MultiResolver([
+            StaticResolver([("a:1", "both")]),
+            Boom(),
+            StaticResolver([("b:2", "decode")]),
+        ])
+        assert await r.resolve() == [("a:1", "both"), ("b:2", "decode")]
+
+    asyncio.run(run())
+
+
+# ---------- e2e: replicas join/leave at runtime, routing follows ----------
+
+def test_gateway_discovery_e2e_join_leave_affinity():
+    """3-act play: (1) two sim replicas route with prefix affinity;
+    (2) a third replica joins via the resolver and receives traffic;
+    (3) the warm replica leaves and its traffic re-routes without errors."""
+    from llm_d_tpu.epp.service import build_gateway
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    class ScriptedResolver:
+        def __init__(self):
+            self.addresses = []
+
+        async def resolve(self):
+            return [(a, "both") for a in self.addresses]
+
+    async def run():
+        sims = {}
+        runners = []
+
+        async def add_sim(i):
+            port = free_port()
+            srv = build_sim_server(SimConfig(
+                model=f"sim-{i}", ttft_ms=1.0, tpot_ms=0.2))
+            runners.append(await _start_app(srv.build_app(), port))
+            sims[i] = f"127.0.0.1:{port}"
+            return sims[i]
+
+        resolver = ScriptedResolver()
+        resolver.addresses = [await add_sim(0), await add_sim(1)]
+
+        gw = build_gateway([], scrape_interval_s=0.05, resolver=resolver,
+                           resolve_interval_s=0.05)
+        gw_port = free_port()
+        runners.append(await _start_app(gw.build_app(), gw_port))
+
+        import aiohttp
+
+        async def wait_ready(n):
+            for _ in range(100):
+                cands = gw.datastore.candidates()
+                if len(cands) == n and all(e.ready for e in cands):
+                    return
+                await asyncio.sleep(0.05)
+            raise AssertionError(
+                f"never saw {n} ready endpoints: {gw.datastore.endpoints}")
+
+        async with aiohttp.ClientSession() as sess:
+            await wait_ready(2)
+
+            async def post(prompt):
+                async with sess.post(
+                        f"http://127.0.0.1:{gw_port}/v1/completions",
+                        json={"prompt": prompt, "max_tokens": 4}) as r:
+                    assert r.status == 200, await r.text()
+                    await r.json()
+                    return r.headers[DESTINATION_HEADER]
+
+            # Act 1: prefix affinity on the discovered set.
+            prompt_a = "alpha " * 200
+            dest_a = await post(prompt_a)
+            for _ in range(3):
+                assert await post(prompt_a) == dest_a
+
+            # Act 2: a replica joins at runtime and receives traffic.
+            addr2 = await add_sim(2)
+            resolver.addresses.append(addr2)
+            await wait_ready(3)
+            hit_new = False
+            for i in range(30):
+                if await post(f"fresh-{i} " * 100) == addr2:
+                    hit_new = True
+                    break
+            assert hit_new, "joined replica never routed to"
+
+            # Act 3: the warm replica leaves; its traffic re-routes cleanly.
+            resolver.addresses.remove(dest_a)
+            await wait_ready(2)
+            assert dest_a not in {e.address
+                                  for e in gw.datastore.candidates()}
+            dest_after = await post(prompt_a)
+            assert dest_after != dest_a
+
+        for r in runners:
+            await r.cleanup()
+
+    asyncio.run(run())

@@ -1,0 +1,146 @@
+"""Gateway flow control: bounded admission queue, queue-depth metric,
+saturation-aware 429/503 (reference: the GAIE flow-control queue,
+example-promQL-queries.md:40-80)."""
+
+import asyncio
+import time
+
+from llm_d_tpu.epp.datastore import EndpointState
+from llm_d_tpu.epp.service import build_gateway
+
+
+def free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _start_app(app, port):
+    from aiohttp import web
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner
+
+
+def test_flow_control_overload():
+    """1 slot + 1 queue seat against a slow replica: concurrent burst ->
+    one serves, one queues (visible in the metric), the rest reject FAST
+    (bounded latency), sheddable requests 429 instead of queueing."""
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    async def run():
+        sim_port = free_port()
+        srv = build_sim_server(SimConfig(
+            model="sim", ttft_ms=400.0, tpot_ms=1.0))
+        runners = [await _start_app(srv.build_app(), sim_port)]
+
+        gw = build_gateway(
+            [EndpointState(address=f"127.0.0.1:{sim_port}")],
+            scrape_interval_s=0.05,
+            max_inflight=1, max_queue=1, queue_timeout_s=5.0)
+        gw_port = free_port()
+        runners.append(await _start_app(gw.build_app(), gw_port))
+
+        import aiohttp
+        async with aiohttp.ClientSession() as sess:
+            for _ in range(50):
+                if all(e.ready for e in gw.datastore.candidates()):
+                    break
+                await asyncio.sleep(0.05)
+
+            url = f"http://127.0.0.1:{gw_port}/v1/completions"
+
+            async def post(priority=0):
+                t0 = time.monotonic()
+                async with sess.post(url, json={
+                        "prompt": "hello", "max_tokens": 2,
+                        "priority": priority}) as r:
+                    await r.read()
+                    return r.status, time.monotonic() - t0
+
+            async def queue_depth():
+                async with sess.get(
+                        f"http://127.0.0.1:{gw_port}/metrics") as r:
+                    text = await r.text()
+                for line in text.splitlines():
+                    if line.startswith(
+                            "inference_extension_flow_control_queue_size"):
+                        return float(line.rsplit(" ", 1)[1])
+                return None
+
+            burst = [asyncio.create_task(post()) for _ in range(4)]
+            await asyncio.sleep(0.15)        # everyone admitted or parked
+            depth = await queue_depth()
+            shed_status, shed_dt = await post(priority=-1)
+            results = await asyncio.gather(*burst)
+            depth_after = await queue_depth()
+
+        statuses = sorted(s for s, _ in results)
+        # 1 in-flight + 1 queued succeed; 2 overflow with 503.
+        assert statuses == [200, 200, 503, 503], results
+        assert depth == 1.0, depth
+        assert shed_status == 429, shed_status
+        assert shed_dt < 0.3, f"sheddable reject not fast: {shed_dt:.2f}s"
+        for s, dt in results:
+            if s == 503:
+                # queue_full rejects immediately, far under the sim's ttft.
+                assert dt < 0.3, f"503 latency unbounded: {dt:.2f}s"
+        assert depth_after == 0.0
+
+        for r in runners:
+            await r.cleanup()
+
+    asyncio.run(run())
+
+
+def test_flow_control_queue_timeout():
+    """A queued request that never gets a slot 503s at queue_timeout."""
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    async def run():
+        sim_port = free_port()
+        srv = build_sim_server(SimConfig(
+            model="sim", ttft_ms=2000.0, tpot_ms=1.0))
+        runners = [await _start_app(srv.build_app(), sim_port)]
+        gw = build_gateway(
+            [EndpointState(address=f"127.0.0.1:{sim_port}")],
+            scrape_interval_s=0.05,
+            max_inflight=1, max_queue=4, queue_timeout_s=0.3)
+        gw_port = free_port()
+        runners.append(await _start_app(gw.build_app(), gw_port))
+
+        import aiohttp
+        async with aiohttp.ClientSession() as sess:
+            for _ in range(50):
+                if all(e.ready for e in gw.datastore.candidates()):
+                    break
+                await asyncio.sleep(0.05)
+            url = f"http://127.0.0.1:{gw_port}/v1/completions"
+
+            async def post():
+                t0 = time.monotonic()
+                async with sess.post(url, json={
+                        "prompt": "x", "max_tokens": 2}) as r:
+                    await r.read()
+                    return r.status, time.monotonic() - t0
+
+            hog = asyncio.create_task(post())
+            await asyncio.sleep(0.05)
+            status, dt = await post()     # queues, then times out
+            assert status == 503, status
+            assert 0.2 < dt < 1.0, dt
+            hog.cancel()
+            try:
+                await hog
+            except (asyncio.CancelledError, Exception):
+                pass
+
+        for r in runners:
+            await r.cleanup()
+
+    asyncio.run(run())
