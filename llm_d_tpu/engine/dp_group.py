@@ -114,10 +114,35 @@ class DPEngineGroup:
         if conn is not None and len(self.engines) > 1:
             # Each rank needs its own transfer server/completion pump; a
             # shared connector would admit rank A's pulls into rank B.
-            raise NotImplementedError(
-                "PD connector on a dp>1 group: construct one connector per "
-                "rank and assign engines[i].kv_connector directly")
+            raise ValueError(
+                "PD connector on a dp>1 group: pass the CONFIG to "
+                "set_kv_connectors() for per-rank servers")
         self.engines[0].kv_connector = conn
+
+    def set_kv_connectors(self, config) -> None:
+        """One transfer server + connector per rank (the reference's
+        flagship config is PD at DP=16, wide-ep decode.yaml:73-96).
+
+        Explicit ports offset by rank (port, port+1, ...); port 0 gives
+        each rank its own ephemeral port.  Each rank's engine advertises
+        ITS connector's port in ``kv_transfer_params`` (the consumer pulls
+        straight from the rank that holds the blocks), and consumer-side
+        pulls are admitted by the rank the dispatcher picked — no
+        cross-rank block traffic."""
+        from llm_d_tpu.transfer import TpuConnector
+        for r, engine in enumerate(self.engines):
+            rank_cfg = dataclasses.replace(
+                config, port=config.port + r if config.port else 0)
+            engine.kv_connector = TpuConnector(rank_cfg)
+
+    @property
+    def kv_connectors(self):
+        return [e.kv_connector for e in self.engines]
+
+    def close_kv_connectors(self) -> None:
+        for e in self.engines:
+            if e.kv_connector is not None:
+                e.kv_connector.close()
 
     @property
     def scheduler(self):
@@ -127,8 +152,12 @@ class DPEngineGroup:
     # ---------- dispatch ----------
 
     def _pick_rank(self) -> int:
-        loads = [e.scheduler.num_waiting + e.scheduler.num_running
-                 for e in self.engines]
+        loads = []
+        for e in self.engines:
+            load = e.scheduler.num_waiting + e.scheduler.num_running
+            if e.kv_connector is not None:
+                load += e.kv_connector.num_pending_loads
+            loads.append(load)
         return loads.index(min(loads))
 
     def add_request(self, request: Request) -> None:

@@ -89,6 +89,10 @@ class EngineConfig:
     # Tiered prefix cache: host-RAM blocks surviving device eviction
     # (reference: tiered-prefix-cache/cpu, OffloadingConnector role).
     kv_offload_blocks: int = 0            # 0 = off
+    # Cross-pod shared tier (the LMCache role): serve host-tier blocks to
+    # peers over the C++ transfer server / consult peers on local miss.
+    kv_shared_tier_port: Optional[int] = None   # None = don't serve; 0 = ephemeral
+    kv_shared_tier_peers: Tuple[str, ...] = ()  # "host:port" peer servers
     # MoE expert-weight quantization (DeepGEMM role; "int8" or None).
     quantization: Optional[str] = None
 
@@ -210,7 +214,10 @@ class EngineCore:
         self.host_tier = None
         if config.kv_offload_blocks > 0:
             from llm_d_tpu.engine.offload import HostKVTier
-            self.host_tier = HostKVTier(self, config.kv_offload_blocks)
+            self.host_tier = HostKVTier(
+                self, config.kv_offload_blocks,
+                serve_port=config.kv_shared_tier_port,
+                peers=list(config.kv_shared_tier_peers))
 
         # Async scheduling: the one in-flight fused decode block.
         self._inflight: Optional[Dict[str, Any]] = None

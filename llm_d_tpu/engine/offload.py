@@ -1,4 +1,4 @@
-"""Tiered prefix cache: host-RAM KV offload behind the block hooks.
+"""Tiered prefix cache: host-RAM KV offload + cross-pod shared tier.
 
 The reference's tiered-prefix-cache path offloads KV to CPU RAM via vLLM's
 ``OffloadingConnector`` / ``LMCacheConnectorV1`` and reports +21.3%
@@ -15,27 +15,89 @@ throughput / -25.6% TTFT on cache-heavy workloads
   - device eviction does NOT remove the host copy — surviving eviction is
     the feature.
 
-Wire metrics: ``llmd_tpu:kv_offload_{saved,loaded}_blocks_total``.
+Cross-pod sharing (the LMCache/InfiniStore role — reference
+Dockerfile.cuda:45-48, lmcache-connector/kustomization.yaml:30): with
+``serve_port`` set, the tier registers every host-resident block with the
+native transfer server under its CHAIN HASH (sha256, deterministic across
+pods), and with ``peers`` set, a local miss falls through to the peers'
+servers before recompute — pod B prefix-hits blocks pod A prefilled.  The
+wire is the same C++ TCP data plane PD transfers use; only the key space
+("b:<hash>" vs request uuid) differs.
+
+Wire metrics: ``llmd_tpu:kv_offload_{saved,loaded}_blocks_total`` and
+``llmd_tpu:kv_shared_tier_{hits,misses}_total``.
 """
 
 from __future__ import annotations
 
 import collections
 import logging
-from typing import Optional
+import struct
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
 
 from llm_d_tpu.transfer.connector import _cache_items, _gather_fn, _scatter_fn
+from llm_d_tpu.transfer import transport
 
 logger = logging.getLogger(__name__)
 
+_SLAB_HEADER = struct.Struct("<III")    # num_buffers, L, bs
+_SLAB_BUF = struct.Struct("<I")         # row width
+
+
+def _shared_key(block_hash: bytes) -> str:
+    return "b:" + block_hash.hex()
+
+
+def _pack_block_slab(slab: Dict[str, np.ndarray]) -> bytes:
+    names = sorted(slab)
+    L, bs, _ = slab[names[0]].shape
+    parts = [_SLAB_HEADER.pack(len(names), L, bs)]
+    for n in names:
+        parts.append(_SLAB_BUF.pack(slab[n].shape[2]))
+        parts.append(np.ascontiguousarray(slab[n]).tobytes())
+    return b"".join(parts)
+
+
+def _unpack_block_slab(blob: bytes, names: List[str],
+                       L: int, bs: int) -> Dict[str, np.ndarray]:
+    import ml_dtypes
+    nb, bL, bbs = _SLAB_HEADER.unpack_from(blob, 0)
+    if (nb, bL, bbs) != (len(names), L, bs):
+        raise ValueError(f"slab layout {(nb, bL, bbs)} != "
+                         f"{(len(names), L, bs)}")
+    off = _SLAB_HEADER.size
+    out = {}
+    for n in sorted(names):
+        (w,) = _SLAB_BUF.unpack_from(blob, off)
+        off += _SLAB_BUF.size
+        count = L * bs * w
+        out[n] = np.frombuffer(blob, dtype=ml_dtypes.bfloat16,
+                               offset=off, count=count).reshape(L, bs, w)
+        off += count * 2
+    return out
+
 
 class HostKVTier:
-    """Host-RAM block store between the device prefix cache and recompute."""
+    """Host-RAM block store between the device prefix cache and recompute.
 
-    def __init__(self, engine, capacity_blocks: int) -> None:
+    ``serve_port``: also serve host-resident blocks to peer pods over the
+    C++ transfer server (0 = ephemeral port, None = don't serve).
+    ``peers``: "host:port" shared-tier servers consulted on local miss.
+    """
+
+    # A peer with this many consecutive transport failures is skipped for
+    # PEER_BACKOFF_S (a dead peer's blackholed IP would otherwise stall the
+    # engine thread peer_timeout_ms per uncached block).
+    PEER_FAILURE_LIMIT = 3
+    PEER_BACKOFF_S = 30.0
+
+    def __init__(self, engine, capacity_blocks: int,
+                 serve_port: Optional[int] = None,
+                 peers: Optional[List[str]] = None,
+                 peer_timeout_ms: int = 500) -> None:
         self.engine = engine
         self.capacity_blocks = capacity_blocks
         # hash -> [2, L, bs, F] host array, LRU order (oldest first).
@@ -45,9 +107,26 @@ class HostKVTier:
         self._pending: list = []
         self.saves = 0
         self.loads = 0
+        self.remote_hits = 0
+        self.remote_misses = 0
+        self.server = None
+        if serve_port is not None:
+            self.server = transport.make_server("0.0.0.0", serve_port)
+        self.peers = list(peers or [])
+        self.peer_timeout_ms = peer_timeout_ms
+        # peer -> (consecutive_failures, retry_after_monotonic)
+        self._peer_health: Dict[str, tuple] = {}
         km = engine.kv_manager
         km.on_block_stored.append(self._on_stored)
         km.secondary_lookup = self._restore
+
+    @property
+    def port(self) -> int:
+        return self.server.port if self.server is not None else 0
+
+    def close(self) -> None:
+        if self.server is not None:
+            self.server.close()
 
     # ---------- device -> host (store path) ----------
 
@@ -85,12 +164,22 @@ class HostKVTier:
             hosts[name] = np.asarray(
                 jax.device_get(slab)).reshape(L, nb_pad, bs, W)
         for i, (h, _) in enumerate(pending):
-            self._store[h] = {name: np.ascontiguousarray(arr[:, i])
-                              for name, arr in hosts.items()}
+            self._insert(h, {name: np.ascontiguousarray(arr[:, i])
+                             for name, arr in hosts.items()})
             self.saves += 1
             e.metrics.kv_offload_saves.inc()
+
+    def _insert(self, block_hash: bytes, slab: Dict[str, np.ndarray]) -> None:
+        """Local store insert mirrored to the shared-tier server; capacity
+        eviction unregisters — the served key set IS the local store."""
+        self._store[block_hash] = slab
+        if self.server is not None:
+            self.server.register(_shared_key(block_hash),
+                                 _pack_block_slab(slab))
         while len(self._store) > self.capacity_blocks:
-            self._store.popitem(last=False)
+            evicted_hash, _ = self._store.popitem(last=False)
+            if self.server is not None:
+                self.server.unregister(_shared_key(evicted_hash))
 
     # ---------- host -> device (restore path) ----------
 
@@ -105,6 +194,8 @@ class HostKVTier:
         NOT be chosen as the restore target (overwriting one mid-lookup
         would silently corrupt the very prefix being assembled)."""
         slab = self._store.get(block_hash)
+        if slab is None and self.peers:
+            slab = self._fetch_from_peers(block_hash)
         if slab is None:
             return None
         e = self.engine
@@ -124,6 +215,52 @@ class HostKVTier:
         self.loads += 1
         e.metrics.kv_offload_loads.inc()
         return b
+
+    def _fetch_from_peers(self, block_hash: bytes) -> Optional[Dict]:
+        """Shared-tier lookup before recompute: try each peer's server.
+
+        A miss is one TCP round trip (sub-ms in-cluster) against the cost
+        of recomputing a whole block's prefill; hits also enter the local
+        host tier so chained lookups and re-requests stay local."""
+        import time as _time
+        e = self.engine
+        key = _shared_key(block_hash)
+        items = _cache_items(e)
+        names = [n for n, _ in items]
+        L = items[0][1].shape[0]
+        bs = e.config.block_size
+        now = _time.monotonic()
+        for peer in self.peers:
+            fails, retry_after = self._peer_health.get(peer, (0, 0.0))
+            if fails >= self.PEER_FAILURE_LIMIT and now < retry_after:
+                continue                      # dead peer in backoff
+            host, _, port = peer.rpartition(":")
+            try:
+                blob = transport.fetch(host, int(port), key,
+                                       timeout_ms=self.peer_timeout_ms)
+                slab = _unpack_block_slab(blob, names, L, bs)
+            except transport.TransferNotFound:
+                # Peer alive, block absent: a healthy miss.
+                self._peer_health.pop(peer, None)
+                continue
+            except (transport.TransferError, ValueError, OSError) as exc:
+                fails += 1
+                self._peer_health[peer] = (
+                    fails, _time.monotonic() + self.PEER_BACKOFF_S)
+                log = (logger.warning
+                       if fails == self.PEER_FAILURE_LIMIT else logger.debug)
+                log("shared-tier peer %s failed (%d consecutive): %s",
+                    peer, fails, exc)
+                continue
+            self._peer_health.pop(peer, None)
+            self.remote_hits += 1
+            e.metrics.kv_shared_tier_hits.inc()
+            slab = {n: np.ascontiguousarray(a) for n, a in slab.items()}
+            self._insert(block_hash, slab)
+            return slab
+        self.remote_misses += 1
+        e.metrics.kv_shared_tier_misses.inc()
+        return None
 
     @property
     def num_blocks(self) -> int:

@@ -97,6 +97,8 @@ class Datastore:
                     pass
         if self._session:
             await self._session.close()
+        if self.resolver is not None and hasattr(self.resolver, "close"):
+            await self.resolver.close()
 
     async def _loop(self) -> None:
         while True:
@@ -115,6 +117,9 @@ class Datastore:
 
     async def resolve_once(self) -> None:
         resolved = await self.resolver.resolve()
+        if resolved is None:    # resolver outage: skip this tick entirely
+            logger.warning("resolver outage; keeping current endpoint set")
+            return
         self.reconcile(resolved)
 
     def reconcile(self, resolved) -> None:

@@ -65,14 +65,16 @@ class DnsResolver:
         self.port = port
         self.role = role
 
-    async def resolve(self) -> List[Resolved]:
+    async def resolve(self) -> Optional[List[Resolved]]:
+        """A lookup ERROR returns None (outage: skip this tick's reconcile);
+        a successful lookup with no records returns []."""
         loop = asyncio.get_running_loop()
         try:
             infos = await loop.getaddrinfo(self.name, self.port,
                                            type=socket.SOCK_STREAM)
         except OSError as exc:
             logger.warning("dns resolve %s failed: %s", self.name, exc)
-            return []
+            return None
         hosts = {info[4][0] for info in infos}
         # Bracket IPv6 hosts so "host:port" splits unambiguously.
         addrs = sorted(
@@ -113,37 +115,58 @@ class K8sEndpointSliceResolver:
         self.api_server = api_server or (
             f"https://{host}:{kport}" if host else None)
         self._token = token
+        self._cached_token: Optional[str] = None
         self._ca_file = ca_file if ca_file is not None else (
             f"{_SA_DIR}/ca.crt" if os.path.exists(f"{_SA_DIR}/ca.crt")
             else None)
+        self._sslctx = None
+        self._session: Optional[aiohttp.ClientSession] = None
 
     def _auth_headers(self) -> dict:
         token = self._token
-        if token is None and os.path.exists(f"{_SA_DIR}/token"):
-            with open(f"{_SA_DIR}/token") as f:
-                token = f.read().strip()
+        if token is None:
+            token = self._cached_token
+            if token is None and os.path.exists(f"{_SA_DIR}/token"):
+                with open(f"{_SA_DIR}/token") as f:
+                    token = f.read().strip()
+                self._cached_token = token
         return {"Authorization": f"Bearer {token}"} if token else {}
 
-    async def resolve(self) -> List[Resolved]:
+    async def _session_get(self) -> aiohttp.ClientSession:
+        # One long-lived session: a fresh TLS handshake to the API server
+        # on every 1s resolve tick is pure waste.
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5))
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def resolve(self) -> Optional[List[Resolved]]:
+        """An API error returns None (outage: skip this tick's reconcile);
+        a successful list with no ready endpoints returns []."""
         if not self.api_server:
             logger.warning("k8s resolver: no API server (not in-cluster?)")
-            return []
+            return None
         url = (f"{self.api_server}/apis/discovery.k8s.io/v1/namespaces/"
                f"{self.namespace}/endpointslices"
                f"?labelSelector=kubernetes.io/service-name={self.service}")
-        sslctx = None
-        if self._ca_file:
-            sslctx = ssl.create_default_context(cafile=self._ca_file)
+        if self._sslctx is None and self._ca_file:
+            self._sslctx = ssl.create_default_context(cafile=self._ca_file)
         try:
-            async with aiohttp.ClientSession(
-                    timeout=aiohttp.ClientTimeout(total=5)) as sess:
-                async with sess.get(url, headers=self._auth_headers(),
-                                    ssl=sslctx) as resp:
-                    resp.raise_for_status()
-                    body = await resp.json()
+            sess = await self._session_get()
+            async with sess.get(url, headers=self._auth_headers(),
+                                ssl=self._sslctx) as resp:
+                if resp.status in (401, 403):
+                    # Token may have rotated; drop the cache for next tick.
+                    self._cached_token = None
+                resp.raise_for_status()
+                body = await resp.json()
         except Exception as exc:
             logger.warning("k8s endpointslice list failed: %s", exc)
-            return []
+            return None
         addrs = set()
         for es in body.get("items", []):
             for ep in es.get("endpoints", []):
@@ -156,21 +179,34 @@ class K8sEndpointSliceResolver:
 
 
 class MultiResolver:
-    """Union of several resolvers (e.g. separate prefill/decode Services)."""
+    """Union of several resolvers (e.g. separate prefill/decode Services).
+
+    If ANY sub-resolver fails (returns None or raises), the whole resolve
+    returns None so the Datastore skips that reconcile tick: acting on a
+    partial union would remove the failed Service's entire endpoint set —
+    and wipe its prefix-index ownership — over one transient DNS/API error.
+    """
 
     def __init__(self, resolvers: Sequence) -> None:
         self.resolvers = list(resolvers)
 
-    async def resolve(self) -> List[Resolved]:
+    async def resolve(self) -> Optional[List[Resolved]]:
         results = await asyncio.gather(
             *(r.resolve() for r in self.resolvers), return_exceptions=True)
         out: List[Resolved] = []
         for r in results:
             if isinstance(r, BaseException):
                 logger.warning("resolver failed: %s", r)
-                continue
+                return None
+            if r is None:
+                return None
             out.extend(r)
         return out
+
+    async def close(self) -> None:
+        for r in self.resolvers:
+            if hasattr(r, "close"):
+                await r.close()
 
 
 def parse_discover_spec(spec: str):

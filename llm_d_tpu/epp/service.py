@@ -67,7 +67,11 @@ class FlowControl:
     async def acquire(self, sheddable: bool) -> str:
         """Returns "ok" (slot held), "saturated" (sheddable, no slot),
         "queue_full", or "timeout"."""
-        if not self._sem.locked():
+        # Fast path only when nobody is parked: on Python <= 3.11
+        # Semaphore.acquire is not FIFO-fair, so without the _queued gate a
+        # steady arrival stream would barge past queued waiters until they
+        # all starve into queue_timeout 503s.
+        if not self._sem.locked() and self._queued == 0:
             await self._sem.acquire()
             return "ok"
         if sheddable:

@@ -434,6 +434,15 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="host-RAM tier capacity in KV blocks (0 = off); evicted "
              "device blocks stay restorable (reference: tiered-prefix-cache)")
     p.add_argument(
+        "--kv-shared-tier-port", type=int, default=None,
+        help="serve host-tier blocks to peer pods on this port (0 = "
+             "ephemeral; requires --kv-offload-blocks > 0; the LMCache "
+             "role)")
+    p.add_argument(
+        "--kv-shared-tier-peers", default="",
+        help="comma list of peer shared-tier servers host:port consulted "
+             "on prefix miss before recompute")
+    p.add_argument(
         "--quantization", default=None, choices=[None, "int8"],
         help="MoE expert-weight quantization (DeepGEMM role; halves "
              "expert HBM residency)")
@@ -477,6 +486,14 @@ def main(argv: Optional[List[str]] = None) -> None:
         from llm_d_tpu.utils.config import apply_file_config, load_layers
         layers = ([args.config] if args.config else []) + args.config_overlay
         apply_file_config(args, p, load_layers(layers), argv=argv)
+    if (args.kv_shared_tier_port is not None
+            or args.kv_shared_tier_peers.strip()) \
+            and args.kv_offload_blocks <= 0:
+        # Silently running with the cross-pod cache off while the operator
+        # configured it is a fleet-wide misconfiguration, not a fallback.
+        p.error("--kv-shared-tier-port/--kv-shared-tier-peers require "
+                "--kv-offload-blocks > 0 (the shared tier serves the host "
+                "tier's blocks)")
     if args.compilation_cache_dir:
         import jax
         jax.config.update("jax_compilation_cache_dir",
@@ -499,6 +516,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         num_scheduler_steps=args.num_scheduler_steps,
         async_scheduling=args.async_scheduling,
         kv_offload_blocks=args.kv_offload_blocks,
+        kv_shared_tier_port=args.kv_shared_tier_port,
+        kv_shared_tier_peers=tuple(
+            s.strip() for s in args.kv_shared_tier_peers.split(",")
+            if s.strip()),
         quantization=args.quantization,
         enable_dbo=args.enable_dbo,
         dbo_decode_token_threshold=args.dbo_decode_token_threshold,
@@ -522,10 +543,18 @@ def main(argv: Optional[List[str]] = None) -> None:
             host=ktc.get("kv_ip", "127.0.0.1"),
             port=int(ktc.get("kv_port", 0)),
             kv_load_failure_policy=ktc.get("kv_load_failure_policy", "fail"))
-        server.engine.kv_connector = TpuConnector(conn_cfg)
-        logger.info("KV connector: role=%s serving on %s:%s",
-                    conn_cfg.kv_role, conn_cfg.host,
-                    server.engine.kv_connector.port)
+        if hasattr(server.engine, "set_kv_connectors"):
+            # DP group: one transfer server per rank, ports offset by rank.
+            server.engine.set_kv_connectors(conn_cfg)
+            logger.info(
+                "KV connectors: role=%s serving on %s ports %s",
+                conn_cfg.kv_role, conn_cfg.host,
+                [c.port for c in server.engine.kv_connectors])
+        else:
+            server.engine.kv_connector = TpuConnector(conn_cfg)
+            logger.info("KV connector: role=%s serving on %s:%s",
+                        conn_cfg.kv_role, conn_cfg.host,
+                        server.engine.kv_connector.port)
     if args.kv_events_endpoint:
         from llm_d_tpu.events.kv_events import ZmqKvEventPublisher
         identity = args.pod_identity

@@ -167,6 +167,14 @@ class TpuConnector:
                 return True
         return bool(self._retry) or bool(self._pin_times)
 
+    @property
+    def num_pending_loads(self) -> int:
+        """In-flight + retry-parked KV pulls: load the scheduler can't see
+        yet (the DP dispatcher counts these, or every PD request would pile
+        onto rank 0 while its pulls are still in flight)."""
+        with self._inflight_mu:
+            return self._inflight + len(self._retry)
+
     def poll(self, engine) -> List[RequestOutput]:
         """Engine-thread pump: finish loads, admit requests, drain releases."""
         self._poll_producer(engine)

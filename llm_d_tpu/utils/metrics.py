@@ -97,6 +97,12 @@ class EngineMetrics:
             "llmd_tpu:kv_offload_saved_blocks_total", "KV blocks offloaded to host tier.")
         self.kv_offload_loads = counter(
             "llmd_tpu:kv_offload_loaded_blocks_total", "KV blocks restored from host tier.")
+        self.kv_shared_tier_hits = counter(
+            "llmd_tpu:kv_shared_tier_hits_total",
+            "KV blocks fetched from a peer pod's shared tier.")
+        self.kv_shared_tier_misses = counter(
+            "llmd_tpu:kv_shared_tier_misses_total",
+            "Shared-tier lookups that missed on every peer.")
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

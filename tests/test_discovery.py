@@ -61,9 +61,9 @@ def test_dns_resolver_localhost():
         res = await DnsResolver("localhost", 8200, role="decode").resolve()
         assert ("127.0.0.1:8200", "decode") in res
 
-        # Unresolvable names degrade to empty (outage != crash).
+        # Unresolvable names signal outage (None), not scale-to-zero ([]).
         assert await DnsResolver(
-            "no-such-host.invalid", 1).resolve() == []
+            "no-such-host.invalid", 1).resolve() is None
 
     asyncio.run(run())
 
@@ -112,9 +112,10 @@ def test_k8s_endpointslice_resolver_fake_api():
                        ("10.0.0.3:8200", "decode"),
                        ("10.0.0.4:8200", "decode")]
 
-        # No API server configured (not in-cluster): empty, not a crash.
-        assert await K8sEndpointSliceResolver(
-            "x", 1, api_server=None).resolve() == []
+        # No API server configured (not in-cluster): outage, not a crash.
+        r = K8sEndpointSliceResolver("x", 1, api_server=None)
+        r.api_server = None     # defeat any in-cluster env autodetection
+        assert await r.resolve() is None
 
     asyncio.run(run())
 
@@ -152,18 +153,27 @@ def test_datastore_reconcile_join_leave():
     assert "10.0.0.1:8200" not in ds.endpoints
 
 
-def test_multi_resolver_union_and_failure_isolation():
+def test_multi_resolver_union_and_outage_propagation():
     class Boom:
         async def resolve(self):
             raise RuntimeError("api down")
 
+    class Outage:
+        async def resolve(self):
+            return None
+
     async def run():
-        r = MultiResolver([
+        ok = MultiResolver([
             StaticResolver([("a:1", "both")]),
-            Boom(),
             StaticResolver([("b:2", "decode")]),
         ])
-        assert await r.resolve() == [("a:1", "both"), ("b:2", "decode")]
+        assert await ok.resolve() == [("a:1", "both"), ("b:2", "decode")]
+
+        # One failed sub-resolver poisons the union: a partial result would
+        # remove the failed Service's whole endpoint set.
+        for bad in (Boom(), Outage()):
+            r = MultiResolver([StaticResolver([("a:1", "both")]), bad])
+            assert await r.resolve() is None
 
     asyncio.run(run())
 

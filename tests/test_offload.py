@@ -65,3 +65,61 @@ def test_host_tier_capacity_lru():
         kv_offload_blocks=2))
     engine.generate([greedy_req("cap", list(range(1, 17)), 2)])  # 4 blocks
     assert engine.host_tier.num_blocks <= 2
+
+
+# ---------------------------------------------------------------------------
+# Cross-pod shared tier (the LMCache role): pod B prefix-hits blocks pod A
+# prefilled, over the transfer-server wire, without recompute.
+# ---------------------------------------------------------------------------
+
+def _mk_engine(**kw):
+    base = dict(model="tiny", block_size=4, num_blocks=16, max_num_seqs=4,
+                max_num_batched_tokens=64, min_token_bucket=16,
+                min_seq_bucket=4, kv_offload_blocks=64)
+    base.update(kw)
+    return EngineCore(EngineConfig(**base))
+
+
+def test_shared_tier_cross_pod_prefix_hit():
+    prompt = [7, 3, 9, 1, 4, 6, 2, 8, 5, 0, 11, 13]   # 3 full blocks
+    pod_a = _mk_engine(kv_shared_tier_port=0)
+    try:
+        first = pod_a.generate([greedy_req("a", prompt, 4)])["a"]
+        assert pod_a.host_tier.port > 0
+        # A's full blocks are registered under their chain hashes.
+        assert pod_a.host_tier.saves >= 3
+
+        pod_b = _mk_engine(
+            kv_shared_tier_peers=(f"127.0.0.1:{pod_a.host_tier.port}",))
+        try:
+            rb = greedy_req("b", prompt, 4)
+            second = pod_b.generate([rb])["b"]
+            assert second == first
+            # The prefix came over the wire, not from recompute: B fetched
+            # remote blocks and its request prefix-hit them.
+            assert pod_b.host_tier.remote_hits >= 2
+            assert rb.num_cached_prompt_tokens >= 8
+            text = pod_b.metrics.render().decode()
+            assert "llmd_tpu:kv_shared_tier_hits_total" in text
+
+            # Different prompt: clean miss path (counted, not fatal).
+            other = [50, 51, 52, 53, 54, 55, 56, 57]
+            pod_b.generate([greedy_req("c", other, 2)])
+            assert pod_b.host_tier.remote_misses >= 1
+        finally:
+            pod_b.host_tier.close()
+    finally:
+        pod_a.host_tier.close()
+
+
+def test_shared_tier_peer_down_degrades_to_recompute():
+    """A dead peer must cost a timeout per block chain at worst, never an
+    error: the request recomputes locally."""
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    solo = _mk_engine()
+    want = solo.generate([greedy_req("s", prompt, 3)])["s"]
+
+    pod = _mk_engine(kv_shared_tier_peers=("127.0.0.1:1",),  # nothing there
+                     )
+    got = pod.generate([greedy_req("x", prompt, 3)])["x"]
+    assert got == want

@@ -355,3 +355,76 @@ def test_sidecar_passthrough_probes(pd_stack):
     r = requests.get(pd_stack + "/metrics", timeout=10)
     assert r.status_code == 200
     assert "vllm:kv_cache_usage_perc" in r.text
+
+
+# ---------------------------------------------------------------------------
+# PD x DP: per-rank connectors (the reference's flagship shape is PD at
+# DP=16 — wide-ep decode.yaml:73-96)
+# ---------------------------------------------------------------------------
+
+def test_pd_dp2_consumer_group(baseline_engine):
+    """Producer -> dp=2 consumer group: every rank owns its own transfer
+    server; pulled requests decode to token parity on whichever rank the
+    dispatcher picked."""
+    import jax
+    from llm_d_tpu.engine.dp_group import DPEngineGroup
+
+    prompts = {
+        "pdda": [3, 1, 4, 1, 5, 9, 2, 6],
+        "pddb": [2, 7, 1, 8, 2, 8],
+        "pddc": [1, 6, 1, 8, 0, 3, 3, 9, 8, 8],
+        "pddd": [5, 5, 5, 5],
+    }
+    n_out = 5
+    expected = baseline_engine.generate(
+        [greedy_req(f"base-{r}", p, n_out) for r, p in prompts.items()])
+
+    producer = EngineCore(EngineConfig(**ENGINE_KW),
+                          params=baseline_engine.params)
+    producer.kv_connector = TpuConnector(
+        KVConnectorConfig(kv_role="kv_producer", host="127.0.0.1"))
+    group = DPEngineGroup(
+        EngineConfig(**ENGINE_KW, allow_device_subset=True), dp_size=2,
+        params=baseline_engine.params, devices=jax.devices()[:2])
+    group.set_kv_connectors(KVConnectorConfig(kv_role="kv_consumer"))
+    try:
+        # Per-rank servers exist only on producer-role connectors; consumer
+        # ranks still get their own pull pumps.
+        assert len(group.kv_connectors) == 2
+        assert all(c is not None for c in group.kv_connectors)
+        assert group.kv_connectors[0] is not group.kv_connectors[1]
+
+        # Remote prefill each request on the producer, then hand the
+        # transfer params to the dp group (least-loaded dispatch spreads
+        # the four requests over both ranks).
+        dreqs = {}
+        for rid, prompt in prompts.items():
+            preq = greedy_req(f"p-{rid}", prompt, 1, do_remote_decode=True)
+            producer.add_request(preq)
+            _drive(producer, lambda preq=preq:
+                   preq.state == RequestState.FINISHED_REMOTE_PREFILL)
+            dreq = greedy_req(rid, prompt, n_out, do_remote_prefill=True,
+                              kv_transfer_params=preq.kv_transfer_params)
+            dreqs[rid] = dreq
+            group.add_request(dreq)
+
+        # Both ranks took a share (4 requests, least-loaded round-robins).
+        share = [group._rank_of[rid] for rid in prompts]
+        assert set(share) == {0, 1}, share
+
+        deadline = time.time() + 60
+        while time.time() < deadline and group.has_work():
+            group.step()
+            time.sleep(0.001)
+        assert not group.has_work()
+
+        # Token parity with the aggregated single engine, per request.
+        for rid in prompts:
+            assert list(dreqs[rid].output_token_ids) \
+                == expected[f"base-{rid}"], rid
+        # Producer pins all released (each rank's pull freed its blocks).
+        _drive(producer, lambda: not producer.pinned_transfers)
+        assert producer.kv_manager.usage == 0.0
+    finally:
+        producer.kv_connector.close()
+        group.close_kv_connectors()
