@@ -61,6 +61,13 @@ class DPEngineGroup:
             rank_cfg = dataclasses.replace(
                 config,
                 mesh=MeshConfig(dp=1, sp=sp, tp=tp) if per_rank > 1 else None,
+                # A fixed shared-tier port would collide across ranks
+                # (every rank's HostKVTier binds its own server): offset
+                # like set_kv_connectors does; 0 stays ephemeral-per-rank.
+                kv_shared_tier_port=(
+                    config.kv_shared_tier_port + r
+                    if config.kv_shared_tier_port else
+                    config.kv_shared_tier_port),
                 allow_device_subset=True)
             rank_devices = devices[r * per_rank:(r + 1) * per_rank]
             engine = EngineCore(rank_cfg, params=params, metrics=self.metrics,

@@ -100,8 +100,15 @@ class HostKVTier:
                  peer_timeout_ms: int = 500) -> None:
         self.engine = engine
         self.capacity_blocks = capacity_blocks
-        # hash -> [2, L, bs, F] host array, LRU order (oldest first).
-        self._store: "collections.OrderedDict[bytes, np.ndarray]" = (
+        # hash -> PACKED block bytes (LRU, oldest first).  Packed bytes are
+        # the canonical representation so serving shares the SAME objects:
+        # the Python transfer server's registry holds references, keeping
+        # host memory at 1x capacity (the C++ server copies each blob into
+        # its own std::string — at the reference's 41,000-block/100 GB
+        # scale that duplication alone would OOM the pod, which is why the
+        # shared tier deliberately uses the Python server; the C++ server
+        # remains the PD data plane where blobs are short-lived).
+        self._store: "collections.OrderedDict[bytes, bytes]" = (
             collections.OrderedDict())
         # Stored-this-step blocks awaiting the batched device_get.
         self._pending: list = []
@@ -111,7 +118,7 @@ class HostKVTier:
         self.remote_misses = 0
         self.server = None
         if serve_port is not None:
-            self.server = transport.make_server("0.0.0.0", serve_port)
+            self.server = transport.PyTransferServer("0.0.0.0", serve_port)
         self.peers = list(peers or [])
         self.peer_timeout_ms = peer_timeout_ms
         # peer -> (consecutive_failures, retry_after_monotonic)
@@ -164,18 +171,19 @@ class HostKVTier:
             hosts[name] = np.asarray(
                 jax.device_get(slab)).reshape(L, nb_pad, bs, W)
         for i, (h, _) in enumerate(pending):
-            self._insert(h, {name: np.ascontiguousarray(arr[:, i])
-                             for name, arr in hosts.items()})
+            self._insert(h, _pack_block_slab(
+                {name: np.ascontiguousarray(arr[:, i])
+                 for name, arr in hosts.items()}))
             self.saves += 1
             e.metrics.kv_offload_saves.inc()
 
-    def _insert(self, block_hash: bytes, slab: Dict[str, np.ndarray]) -> None:
+    def _insert(self, block_hash: bytes, blob: bytes) -> None:
         """Local store insert mirrored to the shared-tier server; capacity
-        eviction unregisters — the served key set IS the local store."""
-        self._store[block_hash] = slab
+        eviction unregisters — the served key set IS the local store (and
+        shares its bytes objects; see __init__)."""
+        self._store[block_hash] = blob
         if self.server is not None:
-            self.server.register(_shared_key(block_hash),
-                                 _pack_block_slab(slab))
+            self.server.register(_shared_key(block_hash), blob)
         while len(self._store) > self.capacity_blocks:
             evicted_hash, _ = self._store.popitem(last=False)
             if self.server is not None:
@@ -193,10 +201,10 @@ class HostKVTier:
         already-matched blocks: they sit refcount-0 in the evictor and MUST
         NOT be chosen as the restore target (overwriting one mid-lookup
         would silently corrupt the very prefix being assembled)."""
-        slab = self._store.get(block_hash)
-        if slab is None and self.peers:
-            slab = self._fetch_from_peers(block_hash)
-        if slab is None:
+        blob = self._store.get(block_hash)
+        if blob is None and self.peers:
+            blob = self._fetch_from_peers(block_hash)
+        if blob is None:
             return None
         e = self.engine
         km = e.kv_manager
@@ -204,6 +212,9 @@ class HostKVTier:
         if b is None:
             return None          # everything free is protected; recompute
         bs = e.config.block_size
+        items = _cache_items(e)
+        slab = _unpack_block_slab(blob, [n for n, _ in items],
+                                  items[0][1].shape[0], bs)
         ids_dev = jax.numpy.asarray(np.asarray([b], np.int32))
         for name, arr in slab.items():
             e.kv_cache[name] = _scatter_fn(1, bs)(
@@ -216,12 +227,13 @@ class HostKVTier:
         e.metrics.kv_offload_loads.inc()
         return b
 
-    def _fetch_from_peers(self, block_hash: bytes) -> Optional[Dict]:
+    def _fetch_from_peers(self, block_hash: bytes) -> Optional[bytes]:
         """Shared-tier lookup before recompute: try each peer's server.
 
         A miss is one TCP round trip (sub-ms in-cluster) against the cost
         of recomputing a whole block's prefill; hits also enter the local
-        host tier so chained lookups and re-requests stay local."""
+        host tier so chained lookups and re-requests stay local.  Returns
+        the PACKED blob (validated)."""
         import time as _time
         e = self.engine
         key = _shared_key(block_hash)
@@ -238,7 +250,7 @@ class HostKVTier:
             try:
                 blob = transport.fetch(host, int(port), key,
                                        timeout_ms=self.peer_timeout_ms)
-                slab = _unpack_block_slab(blob, names, L, bs)
+                _unpack_block_slab(blob, names, L, bs)   # validate layout
             except transport.TransferNotFound:
                 # Peer alive, block absent: a healthy miss.
                 self._peer_health.pop(peer, None)
@@ -255,9 +267,8 @@ class HostKVTier:
             self._peer_health.pop(peer, None)
             self.remote_hits += 1
             e.metrics.kv_shared_tier_hits.inc()
-            slab = {n: np.ascontiguousarray(a) for n, a in slab.items()}
-            self._insert(block_hash, slab)
-            return slab
+            self._insert(block_hash, blob)
+            return blob
         self.remote_misses += 1
         e.metrics.kv_shared_tier_misses.inc()
         return None

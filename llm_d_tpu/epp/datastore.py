@@ -126,21 +126,14 @@ class Datastore:
         """Apply a resolved [(address, role)] set: add joins, drop leaves.
 
         Surviving endpoints keep their EndpointState object (scrape history,
-        readiness); static CLI endpoints are never dropped.  An EMPTY
-        resolve result is treated as a discovery outage, not a scale-to-
-        zero: both resolvers degrade to [] on DNS/API errors, and acting on
-        one transient timeout would drop every endpoint AND fire the
-        on_remove hooks that wipe the prefix index — state that takes
-        minutes of traffic to re-warm.  (True scale-to-zero is safe under
-        this policy too: the vanished pods just fail their scrapes and stop
-        being candidates.)
+        readiness); static CLI endpoints are never dropped.  Outage
+        handling lives in the RESOLVERS (errors resolve to None, which
+        ``resolve_once`` skips; MultiResolver substitutes last-known-good
+        per sub-resolver), so an empty list here genuinely means
+        scale-to-zero and is applied — including on_remove hooks, so the
+        prefix index drops the dead pods' ownership before replacements
+        reuse their addresses.
         """
-        if not resolved and any(a not in self._static
-                                for a in self.endpoints):
-            logger.warning(
-                "resolver returned no endpoints; keeping current set "
-                "(discovery outage policy)")
-            return
         seen = set()
         for address, role in resolved:
             seen.add(address)

@@ -35,7 +35,7 @@ import logging
 import os
 import socket
 import ssl
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import aiohttp
 
@@ -179,28 +179,40 @@ class K8sEndpointSliceResolver:
 
 
 class MultiResolver:
-    """Union of several resolvers (e.g. separate prefill/decode Services).
+    """Union of several resolvers (e.g. separate prefill/decode Services,
+    or k8s-with-DNS-fallback for the same Service).
 
-    If ANY sub-resolver fails (returns None or raises), the whole resolve
-    returns None so the Datastore skips that reconcile tick: acting on a
-    partial union would remove the failed Service's entire endpoint set —
-    and wipe its prefix-index ownership — over one transient DNS/API error.
+    Failure semantics are stale-while-error, per sub-resolver: a failing
+    resolver's LAST KNOWN GOOD result substitutes into the union, so one
+    Service's transient DNS/API error neither removes its endpoints (and
+    wipes their prefix-index ownership) nor blocks updates from the
+    healthy resolvers — the failure mode that would otherwise make
+    k8s+dns redundancy worse than dns alone.  Only when every resolver
+    fails with no history does the whole resolve signal outage (None).
     """
 
     def __init__(self, resolvers: Sequence) -> None:
         self.resolvers = list(resolvers)
+        self._last_good: Dict[int, List[Resolved]] = {}
 
     async def resolve(self) -> Optional[List[Resolved]]:
         results = await asyncio.gather(
             *(r.resolve() for r in self.resolvers), return_exceptions=True)
         out: List[Resolved] = []
-        for r in results:
-            if isinstance(r, BaseException):
-                logger.warning("resolver failed: %s", r)
-                return None
-            if r is None:
-                return None
+        any_ok = False
+        for i, r in enumerate(results):
+            if isinstance(r, BaseException) or r is None:
+                if isinstance(r, BaseException):
+                    logger.warning("resolver %d failed: %s", i, r)
+                stale = self._last_good.get(i)
+                if stale is not None:
+                    out.extend(stale)
+                continue
+            any_ok = True
+            self._last_good[i] = list(r)
             out.extend(r)
+        if not any_ok and not out:
+            return None
         return out
 
     async def close(self) -> None:

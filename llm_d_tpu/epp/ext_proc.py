@@ -1,0 +1,156 @@
+"""Envoy ext_proc front end for the EPP (the reference's data-plane split).
+
+The reference EPP is an ext_proc sidekick: Envoy streams each HTTP request
+to it over a bidirectional gRPC stream, the plugin pipeline picks an
+endpoint, and the EPP answers with a header mutation setting
+``x-gateway-destination-endpoint`` that Envoy's ORIGINAL_DST cluster routes
+on (reference: standalone-inference-scheduling/values.yaml:118-181 — the
+FULL_DUPLEX_STREAMED ext_proc filter + original_dst_cluster;
+inference-scheduling/helmfile.yaml.gotmpl:62-65).  This module is that
+front end over the SAME transport-agnostic ``EppScheduler`` the HTTP
+gateway uses — scheduling behavior is identical on both planes.
+
+Exchange per request (processing_mode: request headers SEND, request body
+BUFFERED — the body carries the model/prompt the scorers need):
+
+  1. ``request_headers``  -> HeadersResponse CONTINUE (wait for body)
+  2. ``request_body``     -> schedule; BodyResponse with
+                             set_headers[x-gateway-destination-endpoint]
+                             (+ x-prefiller-host-port on PD profiles) and
+                             clear_route_cache, or ImmediateResponse
+                             429 (shed) / 503 (no endpoints) / 400.
+
+grpc_tools is absent in this image, so the service is registered by hand
+(a generic stream_stream handler on the Envoy method path) over protoc-
+generated message classes (``protos/external_processor.proto`` — a trimmed
+field-number-compatible subset of the Envoy API).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from concurrent import futures
+from typing import Iterator, Optional
+
+import grpc
+
+from llm_d_tpu.epp.protos import external_processor_pb2 as pb
+from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
+from llm_d_tpu.epp.plugins import RequestCtx
+
+logger = logging.getLogger(__name__)
+
+SERVICE_NAME = "envoy.service.ext_proc.v3.ExternalProcessor"
+METHOD = "Process"
+
+
+def _immediate(code: int, message: str) -> pb.ProcessingResponse:
+    return pb.ProcessingResponse(immediate_response=pb.ImmediateResponse(
+        status=pb.HttpStatus(code=code),
+        body=json.dumps({"error": message}),
+        details=message))
+
+
+def _continue_headers() -> pb.ProcessingResponse:
+    return pb.ProcessingResponse(
+        request_headers=pb.HeadersResponse(response=pb.CommonResponse(
+            status=pb.CommonResponse.CONTINUE)))
+
+
+def _route_response(headers: dict) -> pb.ProcessingResponse:
+    mutation = pb.HeaderMutation(set_headers=[
+        pb.HeaderValueOption(
+            header=pb.HeaderValue(key=k, raw_value=v.encode()),
+            append_action=pb.HeaderValueOption.OVERWRITE_IF_EXISTS_OR_ADD)
+        for k, v in headers.items()])
+    return pb.ProcessingResponse(request_body=pb.BodyResponse(
+        response=pb.CommonResponse(
+            status=pb.CommonResponse.CONTINUE,
+            header_mutation=mutation,
+            clear_route_cache=True)))
+
+
+class ExtProcHandler:
+    """One instance per EPP process; a stream per proxied HTTP request."""
+
+    def __init__(self, scheduler: EppScheduler) -> None:
+        self.scheduler = scheduler
+
+    def process(self, request_iterator: Iterator[pb.ProcessingRequest],
+                context: grpc.ServicerContext
+                ) -> Iterator[pb.ProcessingResponse]:
+        headers: dict = {}
+        body = bytearray()
+        for msg in request_iterator:
+            kind = msg.WhichOneof("request")
+            if kind == "request_headers":
+                headers = {
+                    h.key.lower():
+                        (h.raw_value.decode("utf-8", "replace")
+                         if h.raw_value else h.value)
+                    for h in msg.request_headers.headers.headers}
+                if msg.request_headers.end_of_stream:
+                    # Bodyless request (e.g. GET): nothing to schedule.
+                    yield _continue_headers()
+                    return
+                yield _continue_headers()
+            elif kind == "request_body":
+                body.extend(msg.request_body.body)
+                if not msg.request_body.end_of_stream:
+                    continue
+                yield self._schedule(headers, bytes(body))
+                return
+            elif kind in ("response_headers", "response_body",
+                          "request_trailers", "response_trailers"):
+                # Pass-through phases (our processing_mode skips them, but
+                # a permissive Envoy config must not wedge the stream).
+                yield pb.ProcessingResponse(**{
+                    kind: (pb.HeadersResponse(response=pb.CommonResponse())
+                           if "headers" in kind else
+                           pb.BodyResponse(response=pb.CommonResponse())
+                           if "body" in kind else
+                           pb.TrailersResponse())})
+
+    def _schedule(self, headers: dict, body: bytes) -> pb.ProcessingResponse:
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (json.JSONDecodeError, ValueError) as exc:
+            return _immediate(400, f"invalid json: {exc}")
+        try:
+            ctx = RequestCtx.from_request(payload, headers)
+            result = self.scheduler.schedule(ctx)
+        except (TypeError, ValueError) as exc:
+            return _immediate(400, f"invalid request: {exc}")
+        if ctx.shed:
+            self.scheduler.metrics.shed_total.inc()
+            return _immediate(
+                429, "shed: no endpoint meets the requested SLOs")
+        if result.primary is None:
+            return _immediate(503, "no ready endpoints")
+        out_headers = dict(result.headers)
+        out_headers[DESTINATION_HEADER] = result.primary.address
+        return _route_response(out_headers)
+
+
+def make_server(scheduler: EppScheduler, port: int,
+                host: str = "0.0.0.0", max_workers: int = 16) -> grpc.Server:
+    """Build (not start) the ext_proc gRPC server on ``host:port``."""
+    handler = ExtProcHandler(scheduler)
+    rpc = grpc.stream_stream_rpc_method_handler(
+        handler.process,
+        request_deserializer=pb.ProcessingRequest.FromString,
+        response_serializer=pb.ProcessingResponse.SerializeToString)
+    service = grpc.method_handlers_generic_handler(
+        SERVICE_NAME, {METHOD: rpc})
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers,
+                                   thread_name_prefix="ext-proc"))
+    server.add_generic_rpc_handlers((service,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise RuntimeError(f"ext_proc: could not bind {host}:{port}")
+    server._llmd_port = bound    # ephemeral-port discovery for tests
+    return server

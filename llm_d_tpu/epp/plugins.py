@@ -45,6 +45,30 @@ class RequestCtx:
     shed: bool = False
     predictions: Dict[str, float] = dataclasses.field(default_factory=dict)
 
+    @classmethod
+    def from_request(cls, body: Dict[str, Any],
+                     in_headers: Dict[str, str]) -> "RequestCtx":
+        """Build the pipeline context from a parsed request body + already-
+        lowercased headers.  ONE implementation for every transport front
+        end (HTTP gateway, ext_proc gRPC) — the two planes must schedule a
+        given request identically, so the extraction must not fork."""
+        prompt = body.get("prompt")
+        token_ids = None
+        text = ""
+        if isinstance(prompt, list) and prompt \
+                and isinstance(prompt[0], int):
+            token_ids = prompt
+        elif prompt is not None:
+            text = str(prompt)
+        elif "messages" in body:
+            text = "".join(m.get("content", "")
+                           for m in body.get("messages", []))
+        return cls(body=body, prompt_text=text, token_ids=token_ids,
+                   headers={}, in_headers=in_headers,
+                   priority=int(body.get("priority") or 0),
+                   request_id=in_headers.get(
+                       "x-request-id", body.get("request_id", "")))
+
     def block_keys(self, block_size: int) -> List[bytes]:
         """Chain block hashes for prefix scoring: token ids when present
         (matches the engine's KV block hashing), UTF-8 bytes otherwise."""

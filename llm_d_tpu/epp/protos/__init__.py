@@ -1,0 +1,1 @@
+"""Protoc-generated Envoy API subset (see external_processor.proto)."""

@@ -245,22 +245,8 @@ class Gateway:
                 status=502)
 
     def _make_ctx(self, body: Dict, request: web.Request) -> RequestCtx:
-        prompt = body.get("prompt")
-        token_ids = None
-        text = ""
-        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
-            token_ids = prompt
-        elif prompt is not None:
-            text = str(prompt)
-        elif "messages" in body:
-            text = "".join(m.get("content", "")
-                           for m in body.get("messages", []))
-        return RequestCtx(body=body, prompt_text=text, token_ids=token_ids,
-                          headers={},
-                          in_headers={k.lower(): v
-                                      for k, v in request.headers.items()},
-                          priority=int(body.get("priority") or 0),
-                          request_id=body.get("request_id", ""))
+        return RequestCtx.from_request(
+            body, {k.lower(): v for k, v in request.headers.items()})
 
 
 def build_gateway(
@@ -317,6 +303,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--kv-events-bind", default=None,
                    help="ZMQ bind for engine KV events, e.g. tcp://*:5557 "
                         "(enables the precise prefix index)")
+    p.add_argument("--ext-proc-port", type=int, default=None,
+                   help="also serve the Envoy ext_proc gRPC protocol on "
+                        "this port (reference: the FULL_DUPLEX_STREAMED "
+                        "filter, standalone values.yaml:118-131); the HTTP "
+                        "gateway stays up as the dev path")
     p.add_argument("--max-inflight", type=int, default=256,
                    help="flow control: concurrent upstream requests "
                         "(0 disables flow control)")
@@ -350,7 +341,18 @@ def main(argv: Optional[List[str]] = None) -> None:
                        max_queue=args.max_queue,
                        queue_timeout_s=args.queue_timeout)
     logging.basicConfig(level=logging.INFO)
-    web.run_app(gw.build_app(), host=args.host, port=args.port)
+    ext_server = None
+    if args.ext_proc_port is not None:
+        from llm_d_tpu.epp.ext_proc import make_server as make_ext_proc
+        ext_server = make_ext_proc(gw.scheduler, args.ext_proc_port,
+                                   host=args.host)
+        ext_server.start()
+        logger.info("ext_proc gRPC serving on :%d", args.ext_proc_port)
+    try:
+        web.run_app(gw.build_app(), host=args.host, port=args.port)
+    finally:
+        if ext_server is not None:
+            ext_server.stop(grace=2.0)
 
 
 if __name__ == "__main__":

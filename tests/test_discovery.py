@@ -145,22 +145,25 @@ def test_datastore_reconcile_join_leave():
     # Static CLI endpoints never leave.
     assert "10.0.0.9:1" in ds.endpoints
 
-    # Empty resolve = discovery outage: endpoint set (and prefix-index
-    # ownership) survives; the next good resolve reconciles normally.
+    # Empty resolve = genuine scale-to-zero (resolvers signal outages with
+    # None, which never reaches reconcile): dynamic endpoints drop, their
+    # remove hooks fire, static ones stay.
     ds.reconcile([])
-    assert "10.0.0.1:8200" in ds.endpoints and removed == ["10.0.0.2:8200"]
-    ds.reconcile([("10.0.0.3:8200", "decode")])
-    assert "10.0.0.1:8200" not in ds.endpoints
+    assert set(ds.endpoints) == {"10.0.0.9:1"}
+    assert set(removed) == {"10.0.0.2:8200", "10.0.0.1:8200",
+                            "10.0.0.3:8200"}
 
 
-def test_multi_resolver_union_and_outage_propagation():
-    class Boom:
+def test_multi_resolver_stale_while_error():
+    class Flaky:
+        def __init__(self):
+            self.results = []
+
         async def resolve(self):
-            raise RuntimeError("api down")
-
-    class Outage:
-        async def resolve(self):
-            return None
+            r = self.results.pop(0)
+            if r == "boom":
+                raise RuntimeError("api down")
+            return r
 
     async def run():
         ok = MultiResolver([
@@ -169,11 +172,22 @@ def test_multi_resolver_union_and_outage_propagation():
         ])
         assert await ok.resolve() == [("a:1", "both"), ("b:2", "decode")]
 
-        # One failed sub-resolver poisons the union: a partial result would
-        # remove the failed Service's whole endpoint set.
-        for bad in (Boom(), Outage()):
-            r = MultiResolver([StaticResolver([("a:1", "both")]), bad])
-            assert await r.resolve() is None
+        # A sub-resolver failure substitutes its last-known-good result:
+        # the healthy resolver keeps updating, the flaky one's endpoints
+        # are not removed.
+        flaky = Flaky()
+        flaky.results = [[("c:3", "decode")], None, "boom",
+                         [("c:4", "decode")]]
+        r = MultiResolver([StaticResolver([("a:1", "both")]), flaky])
+        assert await r.resolve() == [("a:1", "both"), ("c:3", "decode")]
+        assert await r.resolve() == [("a:1", "both"), ("c:3", "decode")]
+        assert await r.resolve() == [("a:1", "both"), ("c:3", "decode")]
+        assert await r.resolve() == [("a:1", "both"), ("c:4", "decode")]
+
+        # All resolvers failing with no history = outage (None).
+        flaky2 = Flaky()
+        flaky2.results = ["boom"]
+        assert await MultiResolver([flaky2]).resolve() is None
 
     asyncio.run(run())
 
