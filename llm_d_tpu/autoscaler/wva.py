@@ -79,8 +79,13 @@ class Collector:
     diffed per endpoint (the in-process analogue of the reference's
     Prometheus ``rate()`` queries)."""
 
-    def __init__(self, endpoints: List[str]) -> None:
+    def __init__(self, endpoints: List[str], resolver=None) -> None:
+        """``resolver`` (epp.discovery) makes the replica set dynamic —
+        the autoscaler MUST see the pods the HPA adds/removes, or its
+        capacity math runs on a stale fleet size."""
         self.endpoints = endpoints
+        self._static = list(endpoints)   # CLI entries survive discovery
+        self.resolver = resolver
         self._session: Optional[aiohttp.ClientSession] = None
         self._prev: Dict[str, Dict[str, float]] = {}
 
@@ -91,8 +96,19 @@ class Collector:
     async def stop(self) -> None:
         if self._session:
             await self._session.close()
+        if self.resolver is not None and hasattr(self.resolver, "close"):
+            await self.resolver.close()
 
     async def collect(self) -> List[ReplicaSample]:
+        if self.resolver is not None:
+            resolved = await self.resolver.resolve()
+            if resolved is not None:    # None = outage, keep last set
+                merged = list(self._static)
+                merged.extend(addr for addr, _ in resolved
+                              if addr not in self._static)
+                self.endpoints = merged
+                for gone in set(self._prev) - set(self.endpoints):
+                    del self._prev[gone]    # departed pod: drop diff state
         return list(await asyncio.gather(
             *(self._scrape(ep) for ep in self.endpoints)))
 
@@ -208,9 +224,10 @@ class VariantAutoscaler:
     """The reconcile loop + actuator metric endpoint."""
 
     def __init__(self, spec: VariantAutoscalingSpec, endpoints: List[str],
-                 reconcile_interval_s: float = 60.0) -> None:
+                 reconcile_interval_s: float = 60.0,
+                 resolver=None) -> None:
         self.spec = spec
-        self.collector = Collector(endpoints)
+        self.collector = Collector(endpoints, resolver=resolver)
         self.capacity = CapacityAnalyzer(spec)
         self.model = ModelBasedOptimizer(spec)
         self.reconcile_interval_s = reconcile_interval_s
@@ -288,8 +305,11 @@ class VariantAutoscaler:
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser("llmd-wva")
-    p.add_argument("--endpoints", required=True,
-                   help="comma-separated replica host:port list")
+    p.add_argument("--endpoints", default="",
+                   help="comma-separated static replica host:port list")
+    p.add_argument("--discover", default="",
+                   help="discovery specs (same syntax as llmd-gateway): "
+                        "dns:<headless-svc>:<port> | k8s:[<ns>/]<svc>:<port>")
     p.add_argument("--model-id", default="default")
     p.add_argument("--accelerator", default="v5e")
     p.add_argument("--slo-ttft-ms", type=float, default=1000.0)
@@ -309,8 +329,20 @@ def main(argv=None) -> None:
         slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
         min_replicas=args.min_replicas, max_replicas=args.max_replicas,
         scale_to_zero=args.scale_to_zero, mode=args.mode)
-    wva = VariantAutoscaler(spec, args.endpoints.split(","),
-                            reconcile_interval_s=args.reconcile_interval)
+    resolver = None
+    specs = [s for s in args.discover.split(",") if s.strip()]
+    if specs:
+        from llm_d_tpu.epp.discovery import (MultiResolver,
+                                             parse_discover_spec)
+        resolvers = [parse_discover_spec(s.strip()) for s in specs]
+        resolver = resolvers[0] if len(resolvers) == 1 \
+            else MultiResolver(resolvers)
+    endpoints = [e for e in args.endpoints.split(",") if e.strip()]
+    if not endpoints and resolver is None:
+        p.error("need --endpoints and/or --discover")
+    wva = VariantAutoscaler(spec, endpoints,
+                            reconcile_interval_s=args.reconcile_interval,
+                            resolver=resolver)
     web.run_app(wva.build_app(), host=args.host, port=args.port)
 
 

@@ -88,8 +88,13 @@ class K8sEndpointSliceResolver:
 
     Uses the pod's mounted serviceaccount credentials; ``api_server`` /
     ``token`` / ``ca_file`` are injectable so tests can point it at a fake
-    API server.  Only addresses whose endpoint reports ``conditions.ready``
-    (or leaves it unset, which the API defines as ready) are returned.
+    API server.  ALL addresses are returned, ready or not: discovery
+    answers "which pods exist", while candidacy is the Datastore scrape's
+    job (its ``/metrics`` probe marks unready pods non-candidates).
+    Filtering unready here would make one tick of all-pods-unready — a
+    loaded single replica missing its readiness probe — look like
+    scale-to-zero and wipe prefix-index ownership its intact KV cache
+    still backs.
     """
 
     def __init__(self, service: str, port: int,
@@ -170,9 +175,6 @@ class K8sEndpointSliceResolver:
         addrs = set()
         for es in body.get("items", []):
             for ep in es.get("endpoints", []):
-                ready = ep.get("conditions", {}).get("ready")
-                if ready is False:      # unset counts as ready (API spec)
-                    continue
                 for a in ep.get("addresses", []):
                     addrs.add(f"{a}:{self.port}")
         return [(a, self.role) for a in sorted(addrs)]

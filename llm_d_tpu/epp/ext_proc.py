@@ -58,17 +58,24 @@ def _continue_headers() -> pb.ProcessingResponse:
             status=pb.CommonResponse.CONTINUE)))
 
 
-def _route_response(headers: dict) -> pb.ProcessingResponse:
+def _route_response(headers: dict,
+                    new_body: Optional[bytes] = None
+                    ) -> pb.ProcessingResponse:
     mutation = pb.HeaderMutation(set_headers=[
         pb.HeaderValueOption(
             header=pb.HeaderValue(key=k, raw_value=v.encode()),
             append_action=pb.HeaderValueOption.OVERWRITE_IF_EXISTS_OR_ADD)
         for k, v in headers.items()])
+    common = pb.CommonResponse(
+        status=pb.CommonResponse.CONTINUE,
+        header_mutation=mutation,
+        clear_route_cache=True)
+    if new_body is not None:
+        # BUFFERED mode: Envoy replaces the upstream body and fixes
+        # content-length itself.
+        common.body_mutation.body = new_body
     return pb.ProcessingResponse(request_body=pb.BodyResponse(
-        response=pb.CommonResponse(
-            status=pb.CommonResponse.CONTINUE,
-            header_mutation=mutation,
-            clear_route_cache=True)))
+        response=common))
 
 
 class ExtProcHandler:
@@ -132,7 +139,14 @@ class ExtProcHandler:
             return _immediate(503, "no ready endpoints")
         out_headers = dict(result.headers)
         out_headers[DESTINATION_HEADER] = result.primary.address
-        return _route_response(out_headers)
+        new_body = None
+        if ctx.predictions:
+            # Ride the predictions to the model server (same contract as
+            # the HTTP plane's body["_predicted"] injection) so its usage
+            # frame reports predicted vs actual latency.
+            new_body = json.dumps(
+                dict(payload, _predicted=ctx.predictions)).encode()
+        return _route_response(out_headers, new_body)
 
 
 def make_server(scheduler: EppScheduler, port: int,

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Full suite = gating tier + slow tier (heavy numerical-parity oracles).
+# CI gates on the default `pytest` (fast tier); this script is the
+# pre-merge / nightly run (reference doctrine: CONTRIBUTING.md:135 "gate
+# merges on compilation and passing tests").
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -m "" "$@"

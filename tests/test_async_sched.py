@@ -65,6 +65,7 @@ def test_async_pipeline_actually_engages():
     assert eng._inflight is None
 
 
+@pytest.mark.slow
 def test_async_late_arrival_drains_and_matches_solo():
     eng = EngineCore(_cfg(True))
     first = _reqs("a")

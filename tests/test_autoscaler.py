@@ -135,3 +135,41 @@ def test_actuator_metric_over_http():
     assert 'inferno_desired_replicas{accelerator="v5e",variant_name="sim"}' \
         in text
     assert 'inferno_current_replicas{variant_name="sim"} 2.0' in text
+
+
+def test_collector_follows_resolver():
+    """Scale-out visibility: the collector's replica set tracks discovery
+    (a static list would size capacity on a stale fleet)."""
+    import asyncio
+
+    from llm_d_tpu.autoscaler.wva import Collector
+
+    class Scripted:
+        def __init__(self):
+            self.result = [("10.0.0.1:8200", "both")]
+
+        async def resolve(self):
+            return self.result
+
+    async def run():
+        r = Scripted()
+        c = Collector([], resolver=r)
+        await c.start()
+        try:
+            await c.collect()
+            assert c.endpoints == ["10.0.0.1:8200"]
+            c._prev["10.0.0.1:8200"] = {"x": 1.0}
+
+            r.result = [("10.0.0.2:8200", "both"), ("10.0.0.3:8200", "both")]
+            await c.collect()
+            assert c.endpoints == ["10.0.0.2:8200", "10.0.0.3:8200"]
+            # Departed pod's cumulative-diff state dropped with it.
+            assert "10.0.0.1:8200" not in c._prev
+
+            r.result = None          # discovery outage: keep the last set
+            await c.collect()
+            assert c.endpoints == ["10.0.0.2:8200", "10.0.0.3:8200"]
+        finally:
+            await c.stop()
+
+    asyncio.run(run())

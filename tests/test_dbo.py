@@ -45,6 +45,7 @@ def _case(seed, T, E, H=32, I=16):
     return x, router, w_gate, w_up, w_down
 
 
+@pytest.mark.slow
 def test_dbo_forces_two_chunks_and_matches(mesh, dbo_env, monkeypatch):
     """Above threshold: >= 2 chunks traced, output identical to DBO-off."""
     cfg = ModelConfig(name="dbo-test", num_experts=16, num_experts_per_tok=2,
@@ -96,6 +97,7 @@ def _capture_thresholds(monkeypatch):
     return seen
 
 
+@pytest.mark.slow
 def test_engine_selects_threshold_by_phase(monkeypatch):
     """Prefill programs (Q > 1) get the prefill threshold, pure-decode
     programs (Q == 1, even at num_scheduler_steps=1) the decode one."""
@@ -141,6 +143,7 @@ def test_engine_dbo_guards_dense():
                                 block_size=4, num_blocks=16))
 
 
+@pytest.mark.slow
 def test_engine_dbo_splits_prefill_dispatch(devices, monkeypatch):
     """An enable_dbo engine on the EP mesh must trace >= 2 dispatch chunks
     for a prefill batch above the prefill threshold — no env vars, the

@@ -106,3 +106,59 @@ def test_lws_bootstrap_env_contract():
         coordinator_address="wide-ep-decode-0.wide-ep-decode:8476",
         num_processes=2, process_id=1)
     assert lws_distributed_args({}) is None
+
+
+def test_wide_ep_path_complete():
+    """The wide-EP path ships BOTH LWS halves + sidecar + PD gateway with
+    per-pod discovery (reference: wide-ep-lws manifests/modelserver/base/
+    {prefill,decode}.yaml + inferencepool.values.yaml:24-50)."""
+    d = os.path.join(REPO, "deploy", "wide-ep-lws")
+    prefill = open(os.path.join(d, "prefill-lws.yaml")).read()
+    decode = open(os.path.join(d, "decode-lws.yaml")).read()
+    gateway = open(os.path.join(d, "gateway.yaml")).read()
+
+    # Producer/consumer pairing across the two LWS halves.
+    assert '"kv_role":"kv_producer"' in prefill
+    assert '"kv_role":"kv_consumer"' in decode
+    # Decode keeps the wide-EP serving features on.
+    for flag in ("--enable-eplb", "--enable-dbo", "--async-scheduling"):
+        assert flag in decode, flag
+    # Sidecar rides the decode leader; gateway schedules the PD pair.
+    assert "llmd-sidecar" in decode
+    assert "pd-profile-handler" in gateway
+    assert "=prefill" in gateway and "=decode" in gateway
+    assert "--discover" in gateway          # per-pod, not ClusterIP
+
+    # Both halves export headless per-leader Services for discovery.
+    for text in (prefill, decode):
+        docs = list(yaml.safe_load_all(text))
+        svcs = [x for x in docs if x and x.get("kind") == "Service"]
+        # k8s spells headless as the literal string "None" (YAML parses
+        # the canonical `clusterIP: None` as a string, not null).
+        assert any(s["spec"].get("clusterIP") in (None, "None")
+                   and "clusterIP" in s["spec"] for s in svcs)
+        lws = [x for x in docs if x and x.get("kind") == "LeaderWorkerSet"]
+        assert lws and lws[0]["spec"]["leaderWorkerTemplate"][
+            "restartPolicy"] == "RecreateGroupOnPodRestart"
+
+
+def test_autoscaling_path_complete():
+    """WVA Deployment + PodMonitors + HPA consuming
+    inferno_desired_replicas (reference: workload-autoscaling/
+    README.md:145-151,294; docs/monitoring/README.md:59-82)."""
+    text = open(os.path.join(
+        REPO, "deploy", "workload-autoscaling", "wva.yaml")).read()
+    docs = [d for d in yaml.safe_load_all(text) if d]
+    kinds = [d["kind"] for d in docs]
+    assert kinds.count("PodMonitor") >= 3     # modelservers, gateway, wva
+    assert "HorizontalPodAutoscaler" in kinds
+
+    hpa = next(d for d in docs if d["kind"] == "HorizontalPodAutoscaler")
+    metric = hpa["spec"]["metrics"][0]["external"]["metric"]["name"]
+    assert metric == "inferno_desired_replicas"
+    # The HPA steers the same Deployment the EPP discovers.
+    assert hpa["spec"]["scaleTargetRef"]["name"] == "ms-inference-scheduling"
+
+    wva = next(d for d in docs if d["kind"] == "Deployment")
+    args = wva["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--discover" in args               # per-pod replica visibility

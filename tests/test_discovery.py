@@ -70,7 +70,9 @@ def test_dns_resolver_localhost():
 
 def test_k8s_endpointslice_resolver_fake_api():
     """Points the resolver at a fake API server speaking discovery.k8s.io/v1;
-    asserts label selector, bearer auth, and the ready-condition filter."""
+    asserts label selector, bearer auth, and that unready pods are STILL
+    discovered (candidacy is the scrape's job — an all-unready tick must
+    not read as scale-to-zero)."""
     from aiohttp import web
 
     seen = {}
@@ -83,8 +85,8 @@ def test_k8s_endpointslice_resolver_fake_api():
                 {"addresses": ["10.0.0.1"],
                  "conditions": {"ready": True}},
                 {"addresses": ["10.0.0.2"],
-                 "conditions": {"ready": False}},     # filtered
-                {"addresses": ["10.0.0.3"]},          # unset = ready
+                 "conditions": {"ready": False}},     # still discovered
+                {"addresses": ["10.0.0.3"]},
             ]},
             {"endpoints": [
                 {"addresses": ["10.0.0.4"], "conditions": {}},
@@ -109,6 +111,7 @@ def test_k8s_endpointslice_resolver_fake_api():
         assert seen["selector"] == "kubernetes.io/service-name=ms-decode"
         assert seen["auth"] == "Bearer tok"
         assert res == [("10.0.0.1:8200", "decode"),
+                       ("10.0.0.2:8200", "decode"),
                        ("10.0.0.3:8200", "decode"),
                        ("10.0.0.4:8200", "decode")]
 

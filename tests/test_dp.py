@@ -53,6 +53,7 @@ PROMPTS = {
 }
 
 
+@pytest.mark.slow
 def test_group_matches_single_engine(baseline, group):
     expected = {}
     for rid, p in PROMPTS.items():

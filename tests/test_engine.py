@@ -74,6 +74,7 @@ def test_engine_matches_dense_oracle(engine):
     assert out["a"] == expected
 
 
+@pytest.mark.slow
 def test_concurrent_requests_match_solo_runs(engine):
     prompts = {
         "p1": [2, 4, 6, 8, 10],

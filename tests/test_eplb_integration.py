@@ -58,6 +58,7 @@ def test_physical_table_installed(eplb_engine):
     assert sorted(set(p2l.tolist())) == list(range(E))
 
 
+@pytest.mark.slow
 def test_eplb_outputs_match_baseline_through_rebalance(baseline, eplb_engine):
     prompts = {
         "e1": [3, 1, 4, 1, 5, 9],

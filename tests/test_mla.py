@@ -123,6 +123,7 @@ def test_mla_cache_is_latent_only(engine):
                                 + CFG.v_head_dim)
 
 
+@pytest.mark.slow
 def test_mla_engine_matches_oracle(engine):
     prompt = [3, 14, 159, 26, 53, 5]
     out = engine.generate([greedy_req("m1", prompt, 5)])
@@ -160,6 +161,7 @@ def test_mla_multichip_ep(engine, devices):
     assert out["mc"] == expected
 
 
+@pytest.mark.slow
 def test_mla_no_q_lora_variant():
     """DeepSeek-V2-Lite shape: q_lora_rank=0 -> direct q_proj, same cache."""
     import dataclasses

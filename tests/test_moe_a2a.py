@@ -36,6 +36,7 @@ def _route(x, router, cfg):
 
 
 @pytest.mark.parametrize("T,E", [(16, 8), (32, 16), (16, 64)])
+@pytest.mark.slow
 def test_a2a_matches_psum_oracle(mesh, T, E):
     from llm_d_tpu.models.config import ModelConfig
     cfg = ModelConfig(name="a2a-test", num_experts=E, num_experts_per_tok=2,
@@ -57,6 +58,7 @@ def test_a2a_matches_psum_oracle(mesh, T, E):
                                atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.slow
 def test_a2a_chunked_dispatch_matches(mesh):
     """VLLM_MOE_DP_CHUNK_SIZE analogue: chunked == unchunked."""
     from llm_d_tpu.models.config import ModelConfig
@@ -74,6 +76,7 @@ def test_a2a_chunked_dispatch_matches(mesh):
                                atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.slow
 def test_a2a_skewed_routing(mesh):
     """All tokens routed to ONE shard's experts (worst-case imbalance):
     the fixed-region capacity must absorb it without drops."""

@@ -119,6 +119,7 @@ def test_sampling_greedy_and_topk():
     assert list(np.asarray(ids)) == [1, 3]
 
 
+@pytest.mark.slow
 def test_sampling_top_p_excludes_tail():
     # Token 0 has prob ~0.88 at temp 1; top_p=0.5 must always pick it.
     logits = jnp.tile(jnp.array([[5.0, 3.0, 1.0, 0.0]]), (1, 1))

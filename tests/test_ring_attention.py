@@ -30,6 +30,7 @@ def _case(seed, T, H, KVH, D):
     (MeshConfig(dp=2, sp=4, tp=1), "dp2-sp4"),
 ])
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow
 def test_ring_matches_dense(devices, mesh_cfg, label, causal):
     mesh = make_mesh(mesh_cfg, devices)
     T, H, KVH, D = 64, 4, 2, 16
