@@ -104,8 +104,14 @@ class Collector:
             resolved = await self.resolver.resolve()
             if resolved is not None:    # None = outage, keep last set
                 merged = list(self._static)
-                merged.extend(addr for addr, _ in resolved
-                              if addr not in self._static)
+                seen = set(merged)
+                for addr, _ in resolved:
+                    # Dedupe: k8s+dns redundancy resolves each pod twice;
+                    # double-scraping would double the counter deltas and
+                    # size the fleet at 2x.
+                    if addr not in seen:
+                        seen.add(addr)
+                        merged.append(addr)
                 self.endpoints = merged
                 for gone in set(self._prev) - set(self.endpoints):
                     del self._prev[gone]    # departed pod: drop diff state

@@ -173,21 +173,38 @@ def forward(
                 idx, lp["replica_table"], lp["num_replicas"])
         else:
             phys_idx = idx
-        from llm_d_tpu.ops.quant import expert_weights
-        w_gate, w_up, w_down = expert_weights(lp, hn.dtype)
+        if quant_stacked is not None:
+            # int8 payloads travel to the op STACKED (closure, not scan
+            # xs — a scan slice feeding pallas_call would materialize a
+            # per-layer copy) with the MoE-layer plane index; the TPU
+            # dense path streams them through the Pallas kernel without
+            # a materialized dequant (ops/pallas/moe_int8.py).
+            quant = dict(quant_stacked, layer=li - Ld)
+            w_gate = w_up = w_down = None
+        else:
+            quant = None
+            w_gate, w_up, w_down = lp["w_gate"], lp["w_up"], lp["w_down"]
         m = moe_ops.expert_ffn(
             hn, weights, phys_idx, w_gate, w_up, w_down, mesh=mesh,
-            dbo_min_tokens=dbo_min_tokens)
+            dbo_min_tokens=dbo_min_tokens, quant=quant)
         if "shared_gate" in lp:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])
         return (h + m, caches, li + 1), idx
 
+    ml = params["moe_layers"]
+    quant_keys = ("w_gate_q", "w_gate_s", "w_up_q", "w_up_s",
+                  "w_down_q", "w_down_s")
+    quant_stacked = ({k: ml[k] for k in quant_keys}
+                     if "w_gate_q" in ml else None)
+    moe_scan_params = ({k: v for k, v in ml.items() if k not in quant_keys}
+                       if quant_stacked is not None else ml)
+
     caches0 = tuple(kv_cache[k] for k in cache_keys)
     (x, caches, li), _ = jax.lax.scan(
         dense_body, (x, caches0, jnp.int32(0)), params["dense_layers"])
     (x, caches, _), routed = jax.lax.scan(
-        moe_body, (x, caches, li), params["moe_layers"])
+        moe_body, (x, caches, li), moe_scan_params)
 
     x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
     sample_hidden = x[batch["sample_idx"]]

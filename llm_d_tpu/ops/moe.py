@@ -186,6 +186,42 @@ def _dense_expert_ffn(
 DENSE_DISPATCH_MAX_T = 512
 
 
+def _dense_int8_kernel_path(x, weights, idx, quant: dict,
+                            interpret: bool = False):
+    """Glue for the Pallas streaming kernel: combine-weight scatter + the
+    stacked-payload call.  Factored out so CI can drive the exact wiring
+    in interpret mode (the backend gate above never passes on CPU).
+    ``quant`` must carry STACKED [Lm, E, ...] payloads and a "layer"
+    plane index (the model's contract; see models/moe.py)."""
+    from llm_d_tpu.ops.pallas.moe_int8 import dense_moe_int8
+    T = x.shape[0]
+    E = quant["w_gate_q"].shape[1]
+    comb = jnp.zeros((T, E), jnp.float32).at[
+        jnp.arange(T)[:, None], idx].add(weights)
+    out = dense_moe_int8(
+        x.astype(jnp.bfloat16), comb, quant["layer"],
+        quant["w_gate_q"], quant["w_gate_s"],
+        quant["w_up_q"], quant["w_up_s"],
+        quant["w_down_q"], quant["w_down_s"],
+        interpret=interpret)
+    return out.astype(x.dtype)
+
+
+def _dequant_layer(quant: dict):
+    """Materialized dequant for the non-kernel paths.  Stacked payloads
+    ([Lm, E, ...] + "layer") are sliced to the layer plane first."""
+    from llm_d_tpu.ops.quant import dequantize
+    trip = []
+    for name in ("w_gate", "w_up", "w_down"):
+        q, s = quant[f"{name}_q"], quant[f"{name}_s"]
+        if "layer" in quant:
+            li = quant["layer"]
+            q = jax.lax.dynamic_index_in_dim(q, li, 0, keepdims=False)
+            s = jax.lax.dynamic_index_in_dim(s, li, 0, keepdims=False)
+        trip.append(dequantize(q, s))
+    return tuple(trip)
+
+
 def _excl_cumsum(v: jax.Array) -> jax.Array:
     return jnp.concatenate([jnp.zeros(1, v.dtype), jnp.cumsum(v)[:-1]])
 
@@ -360,12 +396,13 @@ def expert_ffn(
     x: jax.Array,          # [T, H]
     weights: jax.Array,    # [T, k]
     idx: jax.Array,        # [T, k]
-    w_gate: jax.Array,     # [E, H, I] (sharded over EP when mesh given)
-    w_up: jax.Array,
-    w_down: jax.Array,     # [E, I, H]
+    w_gate: Optional[jax.Array],   # [E, H, I] (None when quant is given)
+    w_up: Optional[jax.Array],
+    w_down: Optional[jax.Array],   # [E, I, H]
     mesh: Optional[Mesh] = None,
     dispatch: str = "auto",   # auto | a2a | psum | dense | ragged
     dbo_min_tokens: Optional[int] = None,   # DBO: force >= 2 chunks at this T
+    quant: Optional[dict] = None,   # int8 payloads {w_gate_q, w_gate_s, ...}
 ) -> jax.Array:            # [T, H] in x.dtype
     """Routed-expert FFN, expert-parallel over the flattened mesh.
 
@@ -375,6 +412,14 @@ def expert_ffn(
     Multi-device: sparse all-to-all dispatch by default
     (``LLMD_MOE_DISPATCH=psum`` forces the oracle path; see module
     docstring).
+
+    ``quant`` carries int8 expert payloads END TO END: on the TPU
+    single-device dense path they reach the Pallas streaming kernel
+    WITHOUT a materialized dequant (XLA cannot fuse ``convert(int8)``
+    into a dot operand, and the int8+bf16 round trip costs ~2.5x the
+    quantized bytes — see ops/pallas/moe_int8.py); every other path
+    dequantizes here, which is numerically identical to dequantizing in
+    the model.
     """
     if mesh is None or mesh.devices.size == 1:
         if dispatch == "auto":
@@ -383,12 +428,19 @@ def expert_ffn(
             max_t = int(os.environ.get("LLMD_MOE_DENSE_MAX_T",
                                        str(DENSE_DISPATCH_MAX_T)))
             dispatch = "dense" if x.shape[0] <= max_t else "ragged"
+        if quant is not None and dispatch == "dense" \
+                and jax.default_backend() == "tpu":
+            return _dense_int8_kernel_path(x, weights, idx, quant)
+        if quant is not None:
+            w_gate, w_up, w_down = _dequant_layer(quant)
         if dispatch == "dense":
             out = _dense_expert_ffn(x, weights, idx, w_gate, w_up, w_down)
         else:
             out = _local_expert_ffn(
                 x, weights, idx, w_gate, w_up, w_down, jnp.int32(0))
         return out.astype(x.dtype)
+    if quant is not None:
+        w_gate, w_up, w_down = _dequant_layer(quant)
 
     E = w_gate.shape[0]
     ep = mesh.devices.size

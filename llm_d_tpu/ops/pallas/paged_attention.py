@@ -168,10 +168,13 @@ def _decode_kernel(
                 wk.wait()
                 wv.wait()
 
-        k = k_buf[slot].astype(jnp.float32)                   # [G, bs, F]
-        v = v_buf[slot].astype(jnp.float32)
+        # bf16 operands, f32 accumulation: the MXU runs bf16 at 2x the
+        # f32 rate and the page buffers skip a VPU convert pass; the f32
+        # flash statistics (m, l, acc) keep the recurrence numerics.
+        k = k_buf[slot]                                       # [G, bs, F] bf16
+        v = v_buf[slot]
         s_hb = jax.lax.dot_general(
-            q_full, k, (((2,), (2,)), ((0,), (0,))),
+            q_full.astype(jnp.bfloat16), k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)               # [G, H, bs]
         key_pos = j * bs + jax.lax.broadcasted_iota(
             jnp.int32, (G, 1, bs), 2)
@@ -181,7 +184,7 @@ def _decode_kernel(
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v, (((2,), (1,)), ((0,), (0,))),
+            p.astype(jnp.bfloat16), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)               # [G, H, F]
         acc_new = acc * corr + pv
         return m_new, l_new, acc_new

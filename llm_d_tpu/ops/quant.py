@@ -71,20 +71,3 @@ def quantize_moe_experts(params: Dict[str, Any],
     return out
 
 
-def expert_weights(lp: Dict[str, Any], dtype=jnp.bfloat16):
-    """(w_gate, w_up, w_down) from a (possibly quantized) layer slice.
-
-    The int8 payload is passed through ``optimization_barrier`` before the
-    dequant: without it XLA rewrites ``convert(dynamic_slice(W))`` into
-    ``dynamic_slice(convert(W))`` under the layer scan and materializes the
-    WHOLE expert stack in bf16 — +2x the int8 model's weight footprint,
-    which is exactly the memory the quantization exists to save (observed
-    as an OOM on v5e with the deepseek-v3-bench config)."""
-    out = []
-    for name in EXPERT_WEIGHT_KEYS:
-        if name in lp:
-            out.append(lp[name])
-        else:
-            q = jax.lax.optimization_barrier(lp[f"{name}_q"])
-            out.append(dequantize(q, lp[f"{name}_s"], dtype))
-    return tuple(out)

@@ -1,0 +1,106 @@
+"""Pallas int8 MoE kernel: interpret-mode parity vs the dequantized XLA
+dense path (the kernel's math contract: raw-integer bf16 dots with the
+per-output-column scale applied to the f32 output — numerically the same
+weight-only-int8 scheme as ops.quant.dequantize, so the two paths must
+agree to within bf16 dot noise)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_d_tpu.ops.pallas.moe_int8 import dense_moe_int8
+from llm_d_tpu.ops.quant import dequantize, quantize_int8
+
+
+@pytest.mark.parametrize("T,E,H,I", [(16, 8, 256, 128), (32, 4, 512, 256)])
+def test_kernel_matches_dequantized_dense(T, E, H, I):
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    wg_q, wg_s = quantize_int8(
+        jax.random.normal(ks[1], (E, H, I), jnp.float32) * 0.05)
+    wu_q, wu_s = quantize_int8(
+        jax.random.normal(ks[2], (E, H, I), jnp.float32) * 0.05)
+    wd_q, wd_s = quantize_int8(
+        jax.random.normal(ks[3], (E, I, H), jnp.float32) * 0.05)
+    comb = jnp.abs(jax.random.normal(ks[4], (T, E), jnp.float32)) * 0.2
+    # Zero out most combine entries like real routing does.
+    comb = jnp.where(comb > 0.15, comb, 0.0)
+
+    g = dequantize(wg_q, wg_s)
+    u = dequantize(wu_q, wu_s)
+    d = dequantize(wd_q, wd_s)
+    h = jnp.einsum("th,ehi->eti", x, g, preferred_element_type=jnp.float32)
+    uu = jnp.einsum("th,ehi->eti", x, u, preferred_element_type=jnp.float32)
+    a = (jax.nn.silu(h) * uu * comb.T[:, :, None]).astype(jnp.bfloat16)
+    want = jnp.einsum("eti,eih->th", a, d,
+                      preferred_element_type=jnp.float32)
+
+    # Stacked layout (the engine passes whole [Lm, E, ...] stacks + a
+    # layer index): duplicate the layer twice and address plane 1 to
+    # exercise the scalar-prefetch indexing.
+    stack = lambda a: jnp.stack([jnp.zeros_like(a), a])
+    got = dense_moe_int8(x, comb, 1,
+                         stack(wg_q), stack(wg_s), stack(wu_q),
+                         stack(wu_s), stack(wd_q), stack(wd_s),
+                         interpret=True)
+    scale = float(jnp.max(jnp.abs(want))) + 1e-9
+    np.testing.assert_allclose(np.asarray(got) / scale,
+                               np.asarray(want) / scale, atol=6e-3)
+
+
+def test_kernel_dispatch_wiring_matches_dequant_path():
+    """Drives expert_ffn's ACTUAL kernel glue (_dense_int8_kernel_path:
+    combine scatter + stacked call) in interpret mode against the
+    _dequant_layer fallback — the backend gate hides this wiring from CPU
+    CI otherwise."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    key = jax.random.PRNGKey(1)
+    T, E, H, I, k, Lm = 16, 8, 256, 128, 2, 2
+    ks = jax.random.split(key, 6)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    weights = jnp.abs(jax.random.normal(ks[1], (T, k), jnp.float32))
+    idx = jax.random.randint(ks[2], (T, k), 0, E)
+    quant = {"layer": 1}
+    for name, kk, shape in (("w_gate", ks[3], (Lm, E, H, I)),
+                            ("w_up", ks[4], (Lm, E, H, I)),
+                            ("w_down", ks[5], (Lm, E, I, H))):
+        q, s = quantize_int8(
+            jax.random.normal(kk, shape, jnp.float32) * 0.05)
+        quant[f"{name}_q"], quant[f"{name}_s"] = q, s
+
+    got = moe_ops._dense_int8_kernel_path(x, weights, idx, quant,
+                                          interpret=True)
+    w_gate, w_up, w_down = moe_ops._dequant_layer(quant)
+    want = moe_ops._dense_expert_ffn(x, weights, idx, w_gate, w_up, w_down)
+    scale = float(jnp.max(jnp.abs(want))) + 1e-9
+    np.testing.assert_allclose(np.asarray(got).astype(np.float32) / scale,
+                               np.asarray(want).astype(np.float32) / scale,
+                               atol=1e-2)
+
+
+def test_engine_int8_uses_kernel_only_on_tpu():
+    """On CPU the engine's int8 path must fall back to the XLA dequant
+    dense path (the kernel is TPU-only); generation stays correct."""
+    from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+    from llm_d_tpu.engine.request import Request
+    from llm_d_tpu.ops.sampling import SamplingParams
+
+    def req(rid):
+        return Request(request_id=rid, prompt_token_ids=[1, 2, 3, 4, 5, 6],
+                       sampling=SamplingParams(temperature=0.0, max_tokens=4,
+                                               ignore_eos=True))
+
+    base = EngineCore(EngineConfig(
+        model="tiny-moe", block_size=4, num_blocks=32, max_num_seqs=4,
+        max_num_batched_tokens=64, min_token_bucket=16, min_seq_bucket=4))
+    q = EngineCore(EngineConfig(
+        model="tiny-moe", block_size=4, num_blocks=32, max_num_seqs=4,
+        max_num_batched_tokens=64, min_token_bucket=16, min_seq_bucket=4,
+        quantization="int8"))
+    want = base.generate([req("a")])["a"]
+    got = q.generate([req("b")])["b"]
+    # int8 weight noise may flip late tokens; the first ones must agree.
+    assert got[:2] == want[:2]
