@@ -170,8 +170,7 @@ def _dense_expert_ffn(
     """
     T = x.shape[0]
     E = w_gate.shape[0]
-    comb = jnp.zeros((T, E), jnp.float32).at[
-        jnp.arange(T)[:, None], idx].add(weights)            # [T, E]
+    comb = _combine_matrix(T, E, idx, weights)               # [T, E]
     h = jnp.einsum("th,ehi->eti", x, w_gate,
                    preferred_element_type=jnp.float32)
     u = jnp.einsum("th,ehi->eti", x, w_up,
@@ -196,8 +195,7 @@ def _dense_int8_kernel_path(x, weights, idx, quant: dict,
     from llm_d_tpu.ops.pallas.moe_int8 import dense_moe_int8
     T = x.shape[0]
     E = quant["w_gate_q"].shape[1]
-    comb = jnp.zeros((T, E), jnp.float32).at[
-        jnp.arange(T)[:, None], idx].add(weights)
+    comb = _combine_matrix(T, E, idx, weights)
     out = dense_moe_int8(
         x.astype(jnp.bfloat16), comb, quant["layer"],
         quant["w_gate_q"], quant["w_gate_s"],
@@ -207,16 +205,32 @@ def _dense_int8_kernel_path(x, weights, idx, quant: dict,
     return out.astype(x.dtype)
 
 
+def _combine_matrix(T: int, E: int, idx: jax.Array,
+                    weights: jax.Array) -> jax.Array:
+    """[T, E] f32 combine weights (0 for unrouted pairs); duplicate
+    (token, expert) routes accumulate.  The ONE implementation of the
+    routing->combine contract shared by the dense XLA path, the Pallas
+    int8 kernel glue, and the reference oracle."""
+    return jnp.zeros((T, E), jnp.float32).at[
+        jnp.arange(T)[:, None], idx].add(weights)
+
+
 def _dequant_layer(quant: dict):
     """Materialized dequant for the non-kernel paths.  Stacked payloads
-    ([Lm, E, ...] + "layer") are sliced to the layer plane first."""
+    ([Lm, E, ...] + "layer") are sliced to the layer plane first; the
+    sliced int8 passes through ``optimization_barrier`` before the
+    convert so XLA cannot commute ``convert(dynamic_slice(W))`` into
+    ``dynamic_slice(convert(W))`` and hoist a full-stack bf16 copy out
+    of the layer scan (2x the int8 model's weight footprint — the OOM
+    class observed on v5e at deepseek-v3-bench scale)."""
     from llm_d_tpu.ops.quant import dequantize
     trip = []
     for name in ("w_gate", "w_up", "w_down"):
         q, s = quant[f"{name}_q"], quant[f"{name}_s"]
         if "layer" in quant:
             li = quant["layer"]
-            q = jax.lax.dynamic_index_in_dim(q, li, 0, keepdims=False)
+            q = jax.lax.optimization_barrier(
+                jax.lax.dynamic_index_in_dim(q, li, 0, keepdims=False))
             s = jax.lax.dynamic_index_in_dim(s, li, 0, keepdims=False)
         trip.append(dequantize(q, s))
     return tuple(trip)
@@ -347,7 +361,13 @@ def expert_ffn_a2a(
     # Chunks are data-independent, so XLA's async collectives overlap chunk
     # i+1's ragged all-to-all with chunk i's grouped GEMM — the dual-batch
     # compute/communication overlap, expressed as a schedule the compiler
-    # already knows how to pipeline.  The engine threads the phase-specific
+    # already knows how to pipeline.  Evidence status (r4): the data
+    # independence that overlap REQUIRES is asserted structurally from the
+    # jaxpr (tests/test_dbo.py::test_dbo_chunks_are_data_independent —
+    # chunk i+1's dispatch exchanges consume nothing derived from chunk i),
+    # and chunk count + numerical parity are pinned; a timed A/B of the
+    # overlap itself needs >= 2 real chips, which this environment does not
+    # have (single tunneled v5e).  The engine threads the phase-specific
     # threshold in (decode vs prefill); the env vars are the standalone-op
     # fallback.
     # None -> standalone env fallback; negative -> explicitly disabled (an
@@ -509,8 +529,7 @@ def moe_ffn_reference(
         e_bias=e_bias)
     T, k = idx.shape
     E = w_gate.shape[0]
-    comb = jnp.zeros((T, E), jnp.float32).at[
-        jnp.arange(T)[:, None], idx].add(weights)
+    comb = _combine_matrix(T, E, idx, weights)
     xf = x.astype(jnp.float32)
     h = jnp.einsum("th,ehi->tei", xf, w_gate.astype(jnp.float32))
     u = jnp.einsum("th,ehi->tei", xf, w_up.astype(jnp.float32))

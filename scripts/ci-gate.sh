@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Merge gate (reference doctrine: CONTRIBUTING.md:135 "gate merges on
+# compilation and passing tests"): compile every module, lint the config
+# surface, run the fast test tier.  The slow tier (heavy numerical-parity
+# oracles) runs pre-release via scripts/run-all-tests.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m compileall -q llm_d_tpu tests scripts bench.py __graft_entry__.py
+python scripts/lint-envvars.py
+python -m pytest tests/

@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Env-var registry linter (the reference's lint-envvars.py role).
+
+Fails when an ``LLMD_*`` or ``LWS_*`` variable is (a) read anywhere in
+``llm_d_tpu/`` but missing from ``docs/ENVVARS.md``, or (b) documented
+there but read nowhere — both directions of drift.  Deploy manifests are
+also scanned: an env var set in YAML that the code never reads is a dead
+knob an operator will waste hours on.
+
+Reference doctrine: /root/reference/scripts/lint-envvars.py,
+scripts/ENVVARS.md ("config surface is API surface").
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PREFIXES = ("LLMD_", "LWS_")
+
+READ_RE = re.compile(
+    r"environ(?:\.get\(|\[)\s*\"((?:%s)[A-Z0-9_]+)\"" %
+    "|".join(PREFIXES))
+DOC_RE = re.compile(r"^\|\s*`((?:%s)[A-Z0-9_]+)`" % "|".join(PREFIXES),
+                    re.M)
+YAML_ENV_RE = re.compile(r"name:\s*((?:%s)[A-Z0-9_]+)" % "|".join(PREFIXES))
+
+
+def main() -> int:
+    read = set()
+    for path in (REPO / "llm_d_tpu").rglob("*.py"):
+        read |= set(READ_RE.findall(path.read_text()))
+    # The LWS contract enters through a dict parameter in mesh.py; catch
+    # plain-string reads too.
+    for path in (REPO / "llm_d_tpu").rglob("*.py"):
+        for var in re.findall(r"\"((?:LLMD|LWS)_[A-Z0-9_]+)\"",
+                              path.read_text()):
+            read.add(var)
+
+    doc_text = (REPO / "docs" / "ENVVARS.md").read_text()
+    documented = set(DOC_RE.findall(doc_text))
+
+    manifest_set = set()
+    for path in (REPO / "deploy").rglob("*.yaml"):
+        manifest_set |= set(YAML_ENV_RE.findall(path.read_text()))
+
+    rc = 0
+    undocumented = read - documented
+    if undocumented:
+        rc = 1
+        print(f"UNDOCUMENTED (read in code, absent from docs/ENVVARS.md): "
+              f"{sorted(undocumented)}")
+    stale = documented - read
+    if stale:
+        rc = 1
+        print(f"STALE (documented, read nowhere): {sorted(stale)}")
+    dead_knobs = manifest_set - read
+    if dead_knobs:
+        rc = 1
+        print(f"DEAD MANIFEST KNOBS (set in deploy/, read nowhere): "
+              f"{sorted(dead_knobs)}")
+    if rc == 0:
+        print(f"ok: {len(read)} vars read, all documented; "
+              f"{len(manifest_set)} set in manifests, all live")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

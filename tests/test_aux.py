@@ -106,3 +106,17 @@ def test_config_file_wires_into_server_args(tmp_path):
     assert args.port == 9999
     with pytest.raises(ValueError):
         apply_file_config(args, p, {"nonsense-key": 1}, argv=argv)
+
+
+def test_envvar_lint_gate_passes():
+    """The env-var registry linter (scripts/lint-envvars.py) must pass:
+    every LLMD_*/LWS_* knob read in code is documented in docs/ENVVARS.md
+    and vice versa (reference doctrine: scripts/lint-envvars.py)."""
+    import pathlib
+    import subprocess
+    import sys
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "lint-envvars.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
