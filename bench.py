@@ -135,7 +135,7 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
     max_bs = max(batch_sizes)
     # KV sized to the workload + slack: the tunnel chip's usable HBM is
     # well under the nominal 16 GB, so a fixed large pool OOMs the MoE run.
-    block_size = 64     # fewer, larger page DMAs (~2% over bs=32)
+    block_size = 64     # fewer, larger page DMAs (~2% over bs=32; 128 measured worse)
     num_scheduler_steps = 32
     blocks_per_seq = -(-(prompt_len + decode_steps + num_scheduler_steps + 1)
                        // block_size)
