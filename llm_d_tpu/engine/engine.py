@@ -126,9 +126,21 @@ class EngineCore:
                      if config.mesh
                      else make_mesh(MeshConfig(),
                                     [(devices or jax.devices())[0]]))
+        # SPMD data parallelism: dp > 1 turns on "stacked" mode — batch and
+        # KV arrays carry a leading [dp] dim sharded P("dp"), requests pin
+        # to one dp shard (KV regions), attention runs per shard under
+        # partial-manual shard_map while MoE EP spans ALL devices (the
+        # wide-EP regime; see parallel.dp_attention).  dp == 1 is exactly
+        # the historical single-mesh path.
+        self.dp = config.mesh.dp if config.mesh else 1
+        if self.dp > 1 and (config.mesh.sp or 1) > 1:
+            raise ValueError(
+                "SPMD dp and sp are mutually exclusive in-engine (ring "
+                "attention shards sequences, dp shards requests)")
         self.kv_manager = KVCacheManager(
             config.num_blocks, config.block_size,
-            enable_prefix_caching=config.enable_prefix_caching)
+            enable_prefix_caching=config.enable_prefix_caching,
+            num_regions=self.dp)
         self.scheduler = Scheduler(
             self.kv_manager,
             max_num_seqs=config.max_num_seqs,
@@ -177,16 +189,31 @@ class EngineCore:
         # and contiguous scatter rows (see ops/attention.py docstring).
         # Buffer names/widths come from the model: dense models carry
         # {k, v} of KVH*D each; MLA models ONE latent buffer (models/mla).
+        # Stacked mode prepends a [dp] dim sharded over the dp axis: each
+        # shard owns slots_local = num_slots/dp rows — per-device KV
+        # capacity scales 1/dp, the wide-EP memory profile.
         layout = self.model.kv_cache_layout(c)
-        kv_sharding = {
-            name: NamedSharding(self.mesh, spec)
-            for name, spec in self.model.kv_cache_spec(c).items()}
-        self.kv_cache = {
-            name: jax.device_put(
-                jnp.zeros((c.num_layers, num_slots, width), jnp.bfloat16),
-                kv_sharding[name])
-            for name, width in layout.items()}
+        if self.dp > 1:
+            slots_local = num_slots // self.dp
+            kv_sharding = {
+                name: NamedSharding(self.mesh, P("dp", *spec))
+                for name, spec in self.model.kv_cache_spec(c).items()}
+            self.kv_cache = {
+                name: jax.device_put(
+                    jnp.zeros((self.dp, c.num_layers, slots_local, width),
+                              jnp.bfloat16), kv_sharding[name])
+                for name, width in layout.items()}
+        else:
+            kv_sharding = {
+                name: NamedSharding(self.mesh, spec)
+                for name, spec in self.model.kv_cache_spec(c).items()}
+            self.kv_cache = {
+                name: jax.device_put(
+                    jnp.zeros((c.num_layers, num_slots, width), jnp.bfloat16),
+                    kv_sharding[name])
+                for name, width in layout.items()}
         self._replicated = NamedSharding(self.mesh, P())
+        self._dp_sharded = NamedSharding(self.mesh, P("dp"))
 
         self.max_blocks_per_seq = -(-c.max_model_len // config.block_size)
         self._rng = jax.random.PRNGKey(config.seed)
@@ -221,6 +248,8 @@ class EngineCore:
 
         # Async scheduling: the one in-flight fused decode block.
         self._inflight: Optional[Dict[str, Any]] = None
+        # Stacked mode: EPLB valid-token mask for the last built batch.
+        self._routed_valid: Optional[np.ndarray] = None
 
         self._step_fn = self._build_step_fn()
         # Variant computing top-N logprobs, compiled on first use (steps
@@ -268,6 +297,15 @@ class EngineCore:
                     mesh=mesh, moe_opts=moe_opts)
                 routed = None
             logits = model.compute_logits(params, hidden, c)
+            if logits.ndim == 3:
+                # Stacked (SPMD dp): flatten [dp, S_l, V] -> [dp*S_l, V] so
+                # sampling is row-wise; the merged dim stays dp-sharded and
+                # the host indexes outputs by flat row (shard * S_l + s).
+                logits = logits.reshape(-1, logits.shape[-1])
+                batch = dict(batch, **{
+                    k: batch[k].reshape(-1)
+                    for k in ("temperature", "top_k", "top_p",
+                              "seeds", "gen_idx")})
             ids = sampling_ops.sample(
                 logits, batch["temperature"], batch["top_k"], batch["top_p"],
                 rng, seeds=batch["seeds"], gen_idx=batch["gen_idx"])
@@ -295,27 +333,32 @@ class EngineCore:
 
         @functools.partial(jax.jit, static_argnums=(), donate_argnums=(1,))
         def multistep_fn(params, kv_cache, mbatch, rng):
-            S = mbatch["last_ids"].shape[0]
+            # Row layout: [S] classic, [dp, S_l] stacked (SPMD dp) — all the
+            # index arithmetic below is shape-polymorphic over the leading
+            # dim; sampling flattens rows either way.
+            shape = mbatch["last_ids"].shape
             bt = mbatch["block_tables"]
+            seq_ids = jnp.broadcast_to(
+                jnp.arange(shape[-1], dtype=jnp.int32), shape)
 
             def one_iter(carry, xs):
                 key, it = xs
                 kv_cache, last_ids, pos0 = carry
                 # Decode batch: T == S, one token per sequence.
                 slot = (jnp.take_along_axis(
-                    bt, (pos0 // block_size)[:, None], axis=1)[:, 0]
+                    bt, (pos0 // block_size)[..., None], axis=-1)[..., 0]
                     * block_size + pos0 % block_size)
                 batch = dict(
                     token_ids=last_ids,
                     positions=pos0,
-                    token_seq_ids=jnp.arange(S, dtype=jnp.int32),
-                    token_qpos=jnp.zeros(S, jnp.int32),
+                    token_seq_ids=seq_ids,
+                    token_qpos=jnp.zeros(shape, jnp.int32),
                     slot_mapping=jnp.where(
                         mbatch["active"], slot, pos0 % block_size),
                     block_tables=bt,
                     seq_lens=jnp.where(mbatch["active"], pos0 + 1, 0),
-                    sample_idx=jnp.arange(S, dtype=jnp.int32),
-                    qtok_idx=jnp.arange(S, dtype=jnp.int32)[:, None],
+                    sample_idx=seq_ids,
+                    qtok_idx=seq_ids[..., None],
                 )
                 if collect_routed:
                     hidden, kv_cache, routed = model.forward(
@@ -328,9 +371,13 @@ class EngineCore:
                     routed = jnp.zeros((), jnp.int32)
                 logits = model.compute_logits(params, hidden, c)
                 ids = sampling_ops.sample(
-                    logits, mbatch["temperature"], mbatch["top_k"],
-                    mbatch["top_p"], key, seeds=mbatch["seeds"],
-                    gen_idx=mbatch["gen0"] + it)
+                    logits.reshape(-1, logits.shape[-1]),
+                    mbatch["temperature"].reshape(-1),
+                    mbatch["top_k"].reshape(-1),
+                    mbatch["top_p"].reshape(-1), key,
+                    seeds=mbatch["seeds"].reshape(-1),
+                    gen_idx=(mbatch["gen0"] + it).reshape(-1)
+                ).reshape(shape)
                 ids = jnp.where(mbatch["active"], ids, 0)
                 return (kv_cache, ids, pos0 + 1), (ids, routed)
 
@@ -339,7 +386,7 @@ class EngineCore:
                 one_iter, (kv_cache, mbatch["last_ids"],
                            mbatch["pos0"]),
                 (keys, jnp.arange(K, dtype=jnp.int32)))
-            return ids_ks, kv_cache, routed_ks   # [K, S], ..., [K, Lm, S, k]
+            return ids_ks, kv_cache, routed_ks   # [K, *S], ..., [K, Lm, T, k]
 
         return multistep_fn
 
@@ -373,12 +420,26 @@ class EngineCore:
             allocated.append((req, ok))
         return K
 
-    def _ms_meta(self, scheduled) -> Dict[str, np.ndarray]:
-        """Host-side batch arrays for a fused decode block."""
+    def _block_offset(self, req: Request) -> int:
+        """Global -> shard-local block id rebase for this request (0 when
+        dp == 1: region 0 spans the whole pool)."""
+        return self.kv_manager.region_of_request(req) \
+            * self.kv_manager.blocks_per_region if self.dp > 1 else 0
+
+    def _ms_meta(self, scheduled) -> Tuple[Dict[str, np.ndarray], List,
+                                           np.ndarray]:
+        """Host-side batch arrays for a fused decode block.
+
+        Returns (meta arrays flat over [dp * S_l] rows, scheduled list in
+        row order, row index per scheduled entry).  Block-table ids are
+        shard-local (stacked mode scatters into per-shard cache planes)."""
         cfg = self.config
-        S_real = len(scheduled)
-        S = _next_bucket(S_real, min(cfg.min_seq_bucket, cfg.max_num_seqs),
-                         cfg.max_num_seqs)
+        per = (self._split_by_shard(scheduled) if self.dp > 1
+               else [list(scheduled)])
+        S_l = _next_bucket(max(len(p) for p in per),
+                           min(cfg.min_seq_bucket, cfg.max_num_seqs),
+                           cfg.max_num_seqs)
+        S = S_l * self.dp
         B = self.max_blocks_per_seq
 
         last_ids = np.zeros(S, np.int32)
@@ -390,56 +451,81 @@ class EngineCore:
         top_p = np.ones(S, np.float32)
         seeds = np.full(S, -1, np.int32)
         gen0 = np.zeros(S, np.int32)
-        for s, sr in enumerate(scheduled):
-            req = sr.request
-            last_ids[s] = req.all_token_ids[req.num_computed_tokens]
-            pos0[s] = req.num_computed_tokens
-            block_tables[s, :len(req.block_ids)] = req.block_ids
-            active[s] = True
-            temperature[s] = req.sampling.temperature
-            top_k[s] = req.sampling.top_k
-            top_p[s] = req.sampling.top_p
-            if req.sampling.seed is not None:
-                # Mask into int32: a 64-bit seed must not OverflowError the
-                # batch array (and kill the engine loop for the whole server).
-                seeds[s] = int(req.sampling.seed) & 0x7FFFFFFF
-            gen0[s] = len(req.output_token_ids)
-        return dict(last_ids=last_ids, pos0=pos0, block_tables=block_tables,
+        ordered: List = []
+        rows: List[int] = []
+        for r, shard in enumerate(per):
+            for i, sr in enumerate(shard):
+                s = r * S_l + i
+                req = sr.request
+                ordered.append(sr)
+                rows.append(s)
+                last_ids[s] = req.all_token_ids[req.num_computed_tokens]
+                pos0[s] = req.num_computed_tokens
+                block_tables[s, :len(req.block_ids)] = \
+                    np.asarray(req.block_ids, np.int32) \
+                    - self._block_offset(req)
+                active[s] = True
+                temperature[s] = req.sampling.temperature
+                top_k[s] = req.sampling.top_k
+                top_p[s] = req.sampling.top_p
+                if req.sampling.seed is not None:
+                    # Mask into int32: a 64-bit seed must not OverflowError
+                    # the batch array (and kill the whole server's loop).
+                    seeds[s] = int(req.sampling.seed) & 0x7FFFFFFF
+                gen0[s] = len(req.output_token_ids)
+        meta = dict(last_ids=last_ids, pos0=pos0, block_tables=block_tables,
                     active=active, temperature=temperature, top_k=top_k,
                     top_p=top_p, seeds=seeds, gen0=gen0)
+        return meta, ordered, np.asarray(rows, np.int32)
 
-    def _ms_dispatch(self, meta: Dict[str, Any], scheduled, K: int
-                     ) -> Dict[str, Any]:
+    def _ms_dispatch(self, meta: Dict[str, Any], scheduled, K: int,
+                     rows: np.ndarray) -> Dict[str, Any]:
         """Launch one fused decode block; returns the in-flight record
-        WITHOUT synchronizing (ids stay on device until retire)."""
-        mbatch = jax.device_put(
-            {k: (v if isinstance(v, jax.Array) else jnp.asarray(v))
-             for k, v in meta.items()},
-            self._replicated)
+        WITHOUT synchronizing (ids stay on device until retire).
+
+        Stacked mode reshapes the flat host meta to [dp, S_l, ...] sharded
+        P("dp"); device arrays riding over from a predecessor block
+        (``last_ids``) already carry the stacked shape."""
+        if self.dp > 1:
+            S_l = meta["pos0"].shape[0] // self.dp
+
+            def to_dev(v):
+                if isinstance(v, jax.Array):
+                    return v
+                return jnp.asarray(v.reshape(self.dp, S_l, *v.shape[1:]))
+            mbatch = jax.device_put(
+                {k: to_dev(v) for k, v in meta.items()}, self._dp_sharded)
+        else:
+            mbatch = jax.device_put(
+                {k: (v if isinstance(v, jax.Array) else jnp.asarray(v))
+                 for k, v in meta.items()},
+                self._replicated)
         self._rng, step_key = jax.random.split(self._rng)
         ids_ks, self.kv_cache, routed_ks = self._multistep_fn(
             self.params, self.kv_cache, mbatch, step_key)
-        return dict(scheduled=list(scheduled), K=K, meta=meta,
+        return dict(scheduled=list(scheduled), K=K, meta=meta, rows=rows,
                     ids_dev=ids_ks, routed_dev=routed_ks)
 
     def _ms_retire(self, inflight: Dict[str, Any]) -> List[RequestOutput]:
         """Synchronize one in-flight block and advance request state."""
         scheduled, K = inflight["scheduled"], inflight["K"]
-        S_real = len(scheduled)
-        ids_ks = np.asarray(jax.device_get(inflight["ids_dev"]))   # [K, S]
+        rows = inflight["rows"]
+        # [K, S] / [K, dp, S_l] -> [K, S_total] flat rows.
+        ids_ks = np.asarray(jax.device_get(inflight["ids_dev"]))
+        ids_ks = ids_ks.reshape(K, -1)
         self._step_count += K
         if self.eplb is not None:
             # Fused decode is EXACTLY the traffic EPLB exists to balance;
-            # only the first S_real rows are real sequences.  (A successor
-            # block already dispatched keeps using the pre-rebalance physical
+            # only real sequences' rows count.  (A successor block already
+            # dispatched keeps using the pre-rebalance physical
             # table+weights pair — consistent, balanced one block later.)
             self.params = self.eplb.on_step(
-                inflight["routed_dev"][:, :, :S_real, :], self._step_count,
+                inflight["routed_dev"][:, :, rows, :], self._step_count,
                 self.params, self.mesh)
 
         outputs: List[RequestOutput] = []
         now = time.monotonic()
-        for s, sr in enumerate(scheduled):
+        for s, sr in zip(rows, scheduled):
             req = sr.request
             if req.state is not RequestState.RUNNING:
                 # Finished (stop in an earlier retire) or aborted while this
@@ -492,10 +578,10 @@ class EngineCore:
             return None
         scheduled, K = inflight["scheduled"], inflight["K"]
         meta = inflight["meta"]
-        S_real = len(scheduled)
+        rows = inflight["rows"]
         max_len = self.model_config.max_model_len
         live = 0
-        for s, sr in enumerate(scheduled):
+        for s, sr in zip(rows, scheduled):
             req = sr.request
             if req.state is not RequestState.RUNNING:
                 continue
@@ -510,11 +596,11 @@ class EngineCore:
         # allocation — they become pad rows below, so memory pressure from
         # their dying breath can't drain the pipeline.
         finishing = [int(meta["gen0"][s]) + K >= sr.request.sampling.max_tokens
-                     for s, sr in enumerate(scheduled)]
+                     for s, sr in zip(rows, scheduled)]
         allocated: List[Tuple[Request, List[int]]] = []
-        for s, sr in enumerate(scheduled):
+        for (s, sr), fin in zip(zip(rows, scheduled), finishing):
             req = sr.request
-            if req.state is not RequestState.RUNNING or finishing[s]:
+            if req.state is not RequestState.RUNNING or fin:
                 continue
             ok = self.kv_manager.allocate(req, int(meta["pos0"][s]) + 2 * K)
             if ok is None:
@@ -526,8 +612,8 @@ class EngineCore:
         bt = meta["block_tables"]
         next_bt = bt
         next_active = meta["active"]
-        for s, sr in enumerate(scheduled):
-            if sr.request.state is not RequestState.RUNNING or finishing[s]:
+        for (s, sr), fin in zip(zip(rows, scheduled), finishing):
+            if sr.request.state is not RequestState.RUNNING or fin:
                 # Requests that stopped in an earlier retire — or that will
                 # stop at their length limit in the in-flight block — become
                 # pad rows: seq_len 0 (no attention), trash-block writes.
@@ -535,24 +621,26 @@ class EngineCore:
                     next_active = next_active.copy()
                 next_active[s] = False
                 continue
-            nb = len(sr.request.block_ids)
-            if nb and bt[s, nb - 1] != sr.request.block_ids[-1]:
+            local = np.asarray(sr.request.block_ids, np.int32) \
+                - self._block_offset(sr.request)
+            nb = len(local)
+            if nb and bt[s, nb - 1] != local[-1]:
                 if next_bt is bt:
                     next_bt = bt.copy()
-                next_bt[s, :nb] = sr.request.block_ids
+                next_bt[s, :nb] = local
+        last_dev = inflight["ids_dev"][K - 1]      # device array, no sync
         next_meta = dict(
             meta,
-            last_ids=inflight["ids_dev"][K - 1],   # device array, no sync
+            last_ids=last_dev,
             pos0=meta["pos0"] + np.int32(K),
             gen0=meta["gen0"] + np.int32(K),
             block_tables=next_bt,
             active=next_active)
-        return self._ms_dispatch(next_meta, scheduled, K)
+        return self._ms_dispatch(next_meta, scheduled, K, rows)
 
     def _run_multistep(self, sched: SchedulerOutput, K: int) -> List[RequestOutput]:
-        return self._ms_retire(
-            self._ms_dispatch(self._ms_meta(sched.scheduled),
-                              sched.scheduled, K))
+        meta, ordered, rows = self._ms_meta(sched.scheduled)
+        return self._ms_retire(self._ms_dispatch(meta, ordered, K, rows))
 
     # ---------- public API ----------
 
@@ -615,71 +703,114 @@ class EngineCore:
 
     # ---------- batch building ----------
 
-    def _build_batch(self, out: SchedulerOutput) -> Tuple[Dict[str, jax.Array], List]:
-        cfg = self.config
-        bs = cfg.block_size
-        S_real = len(out.scheduled)
-        T_real = out.total_tokens
-        T = _next_bucket(T_real, cfg.min_token_bucket, cfg.max_num_batched_tokens)
-        S = _next_bucket(S_real, min(cfg.min_seq_bucket, cfg.max_num_seqs),
-                         cfg.max_num_seqs)
-        B = self.max_blocks_per_seq
+    def _empty_batch_np(self, T: int, S: int, Q: int, B: int) -> Dict[str, np.ndarray]:
+        return dict(
+            token_ids=np.zeros(T, np.int32),
+            positions=np.zeros(T, np.int32),
+            token_seq_ids=np.zeros(T, np.int32),
+            token_qpos=np.zeros(T, np.int32),
+            slot_mapping=np.zeros(T, np.int32),  # local block 0 = trash
+            block_tables=np.zeros((S, B), np.int32),
+            seq_lens=np.zeros(S, np.int32),
+            sample_idx=np.zeros(S, np.int32),
+            qtok_idx=np.full((S, Q), T, np.int32),  # T = padded-q sentinel
+            temperature=np.zeros(S, np.float32),
+            top_k=np.zeros(S, np.int32),
+            top_p=np.ones(S, np.float32),
+            seeds=np.full(S, -1, np.int32),
+            gen_idx=np.zeros(S, np.int32))
 
-        # Per-seq query-slot bucket: 1 on pure-decode steps, else pow2.
-        max_q = max((sr.num_new_tokens for sr in out.scheduled), default=1)
-        Q = 1 if max_q == 1 else _next_bucket(
-            max_q, cfg.min_token_bucket, cfg.max_num_batched_tokens)
-
-        token_ids = np.zeros(T, np.int32)
-        positions = np.zeros(T, np.int32)
-        token_seq_ids = np.zeros(T, np.int32)
-        token_qpos = np.zeros(T, np.int32)
-        slot_mapping = np.zeros(T, np.int32)   # block 0 = trash for padding
-        block_tables = np.zeros((S, B), np.int32)
-        seq_lens = np.zeros(S, np.int32)
-        sample_idx = np.zeros(S, np.int32)
-        qtok_idx = np.full((S, Q), T, np.int32)  # T = padded-q sentinel row
-        temperature = np.zeros(S, np.float32)
-        top_k = np.zeros(S, np.int32)
-        top_p = np.ones(S, np.float32)
-        seeds = np.full(S, -1, np.int32)
-        gen_idx = np.zeros(S, np.int32)
-
+    def _fill_batch(self, arrs: Dict[str, np.ndarray], scheduled,
+                    block_offset: int = 0) -> None:
+        """Fill one (shard's) batch arrays from its scheduled requests.
+        ``block_offset`` rebases global block ids to shard-local ones
+        (stacked mode; 0 for the classic single-mesh path)."""
+        bs = self.config.block_size
         t = 0
-        for s, sr in enumerate(out.scheduled):
+        for s, sr in enumerate(scheduled):
             req, n = sr.request, sr.num_new_tokens
             start = req.num_computed_tokens
             toks = req.all_token_ids[start:start + n]
-            token_ids[t:t + n] = toks
+            arrs["token_ids"][t:t + n] = toks
             pos_arr = np.arange(start, start + n)
-            positions[t:t + n] = pos_arr
-            token_seq_ids[t:t + n] = s
-            blocks = np.asarray(req.block_ids, np.int32)
-            slot_mapping[t:t + n] = blocks[pos_arr // bs] * bs + pos_arr % bs
-            token_qpos[t:t + n] = np.arange(n)
-            qtok_idx[s, :n] = np.arange(t, t + n)
+            arrs["positions"][t:t + n] = pos_arr
+            arrs["token_seq_ids"][t:t + n] = s
+            blocks = np.asarray(req.block_ids, np.int32) - block_offset
+            arrs["slot_mapping"][t:t + n] = \
+                blocks[pos_arr // bs] * bs + pos_arr % bs
+            arrs["token_qpos"][t:t + n] = np.arange(n)
+            arrs["qtok_idx"][s, :n] = np.arange(t, t + n)
             nb = len(req.block_ids)
-            block_tables[s, :nb] = req.block_ids
-            seq_lens[s] = start + n
-            sample_idx[s] = t + n - 1
+            arrs["block_tables"][s, :nb] = blocks
+            arrs["seq_lens"][s] = start + n
+            arrs["sample_idx"][s] = t + n - 1
             sp = req.sampling
-            temperature[s] = sp.temperature
-            top_k[s] = sp.top_k
-            top_p[s] = sp.top_p
+            arrs["temperature"][s] = sp.temperature
+            arrs["top_k"][s] = sp.top_k
+            arrs["top_p"][s] = sp.top_p
             if sp.seed is not None:
-                seeds[s] = int(sp.seed) & 0x7FFFFFFF   # int32-safe (see above)
-            gen_idx[s] = len(req.output_token_ids)
+                # Mask into int32: a 64-bit seed must not OverflowError the
+                # batch array (and kill the engine loop for the whole server).
+                arrs["seeds"][s] = int(sp.seed) & 0x7FFFFFFF
+            arrs["gen_idx"][s] = len(req.output_token_ids)
             t += n
 
-        batch_np = dict(
-            token_ids=token_ids, positions=positions,
-            token_seq_ids=token_seq_ids, token_qpos=token_qpos,
-            slot_mapping=slot_mapping, block_tables=block_tables,
-            seq_lens=seq_lens, sample_idx=sample_idx, qtok_idx=qtok_idx,
-            temperature=temperature, top_k=top_k, top_p=top_p,
-            seeds=seeds, gen_idx=gen_idx)
-        batch = jax.device_put(batch_np, self._replicated)
-        return batch, out.scheduled
+    def _split_by_shard(self, scheduled) -> List[List]:
+        per: List[List] = [[] for _ in range(self.dp)]
+        for sr in scheduled:
+            per[self.kv_manager.region_of_request(sr.request)].append(sr)
+        return per
+
+    def _build_batch(self, out: SchedulerOutput
+                     ) -> Tuple[Dict[str, jax.Array], List, np.ndarray]:
+        """Returns (device batch, scheduled list, flat sample-row index per
+        scheduled entry).  Stacked mode groups requests by their KV shard
+        and pads every shard to common [T_l]/[S_l] buckets."""
+        cfg = self.config
+        B = self.max_blocks_per_seq
+        max_q = max((sr.num_new_tokens for sr in out.scheduled), default=1)
+
+        if self.dp == 1:
+            S_real = len(out.scheduled)
+            T = _next_bucket(out.total_tokens, cfg.min_token_bucket,
+                             cfg.max_num_batched_tokens)
+            S = _next_bucket(S_real, min(cfg.min_seq_bucket, cfg.max_num_seqs),
+                             cfg.max_num_seqs)
+            # Per-seq query-slot bucket: 1 on pure-decode steps, else pow2.
+            Q = 1 if max_q == 1 else _next_bucket(
+                max_q, cfg.min_token_bucket, cfg.max_num_batched_tokens)
+            arrs = self._empty_batch_np(T, S, Q, B)
+            self._fill_batch(arrs, out.scheduled)
+            batch = jax.device_put(arrs, self._replicated)
+            return batch, out.scheduled, np.arange(S_real)
+
+        per = self._split_by_shard(out.scheduled)
+        T_l = _next_bucket(
+            max(sum(sr.num_new_tokens for sr in shard) for shard in per),
+            cfg.min_token_bucket, cfg.max_num_batched_tokens)
+        S_l = _next_bucket(
+            max(len(shard) for shard in per),
+            min(cfg.min_seq_bucket, cfg.max_num_seqs), cfg.max_num_seqs)
+        Q = 1 if max_q == 1 else _next_bucket(
+            max_q, cfg.min_token_bucket, cfg.max_num_batched_tokens)
+        B_l = self.kv_manager.blocks_per_region
+        shard_arrs = []
+        scheduled_flat: List = []
+        rows: List[int] = []
+        valid = np.zeros(self.dp * T_l, bool)
+        for r, shard in enumerate(per):
+            arrs = self._empty_batch_np(T_l, S_l, Q, B)
+            self._fill_batch(arrs, shard, block_offset=r * B_l)
+            shard_arrs.append(arrs)
+            scheduled_flat.extend(shard)
+            rows.extend(r * S_l + s for s in range(len(shard)))
+            n_real = sum(sr.num_new_tokens for sr in shard)
+            valid[r * T_l:r * T_l + n_real] = True
+        self._routed_valid = valid     # EPLB: mask pad rows per shard
+        stacked_np = {k: np.stack([a[k] for a in shard_arrs])
+                      for k in shard_arrs[0]}
+        batch = jax.device_put(stacked_np, self._dp_sharded)
+        return batch, scheduled_flat, np.asarray(rows, np.int32)
 
     # ---------- step ----------
 
@@ -711,13 +842,13 @@ class EngineCore:
         K = self._try_multistep(sched)
         if K is not None:
             if self.config.async_scheduling:
-                self._inflight = self._ms_dispatch(
-                    self._ms_meta(sched.scheduled), sched.scheduled, K)
+                meta, ordered, rows = self._ms_meta(sched.scheduled)
+                self._inflight = self._ms_dispatch(meta, ordered, K, rows)
                 return outputs    # this block's tokens arrive next step
             outputs.extend(self._run_multistep(sched, K))
             return outputs
 
-        batch, scheduled = self._build_batch(sched)
+        batch, scheduled, rows = self._build_batch(sched)
         self._rng, step_key = jax.random.split(self._rng)
         # top_logprobs=0 means chosen-token logprob only (no alternatives).
         want_top = any((sr.request.sampling.logprobs or 0) > 0
@@ -745,12 +876,16 @@ class EngineCore:
             # the zero-embedding's favorite expert doesn't skew the stats)
             # and rebalance the physical placement on the interval.
             if routed is not None:
-                routed = routed[:, :sched.total_tokens, :]
+                if self._routed_valid is not None:   # stacked: ragged pads
+                    routed = routed[:, self._routed_valid, :]
+                else:
+                    routed = routed[:, :sched.total_tokens, :]
             self.params = self.eplb.on_step(
                 routed, self._step_count, self.params, self.mesh)
 
         now = time.monotonic()
-        for s, sr in enumerate(scheduled):
+        for i, sr in enumerate(scheduled):
+            s = int(rows[i])
             req, n = sr.request, sr.num_new_tokens
             req.num_computed_tokens += n
             produced_token = req.num_computed_tokens == req.num_tokens

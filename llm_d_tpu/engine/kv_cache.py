@@ -8,8 +8,16 @@ evictor so later requests with a shared prefix reuse them — because the
 scheduler-side prefix scorers (reference: gaie values, SURVEY.md §2.4) are
 calibrated against exactly this behavior.
 
-Block 0 is reserved as the null/trash block (padding writes, null table
-entries) and is never allocated.
+Regions (SPMD data parallelism): with ``num_regions = dp > 1`` the pool is
+partitioned so region ``r`` owns global blocks [r*B_l, (r+1)*B_l), whose
+device rows live in dp-shard ``r`` of the engine's stacked cache.  A request
+is pinned to one region at admission (``assign_region``) so every page it
+touches is shard-local — device attention never crosses the dp axis (the
+reference's per-rank KV in vLLM DP engine cores, wide-ep decode.yaml:73-93).
+Block ids stay GLOBAL on the host: region / local ids are pure arithmetic
+(``block // B_l``, ``block % B_l``).  Each region's local block 0 is its
+null/trash block (padding rows of that shard's batch scatter there) and is
+never allocated; with one region this is the classic reserved block 0.
 """
 
 from __future__ import annotations
@@ -32,41 +40,70 @@ class KVCacheManager:
         block_size: int,
         enable_prefix_caching: bool = True,
         hash_seed: str = "42",
+        num_regions: int = 1,
     ) -> None:
-        assert num_blocks >= 2
+        assert num_blocks >= 2 * num_regions
+        assert num_blocks % num_regions == 0, \
+            f"num_blocks {num_blocks} not divisible by {num_regions} regions"
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.enable_prefix_caching = enable_prefix_caching
         self.hash_seed = hash_seed
+        self.num_regions = num_regions
+        self.blocks_per_region = num_blocks // num_regions
 
-        # Blocks 1..num_blocks-1 are allocatable.
-        self._free: collections.deque[int] = collections.deque(range(1, num_blocks))
+        B_l = self.blocks_per_region
+        self._free: List[collections.deque[int]] = [
+            collections.deque(range(r * B_l + 1, (r + 1) * B_l))
+            for r in range(num_regions)]
         self._ref: Dict[int, int] = {}                   # block -> refcount
         self._hash_of: Dict[int, bytes] = {}             # block -> content hash
         self._cached: Dict[bytes, int] = {}              # hash -> block
-        # Free-but-cached blocks in LRU order (oldest first).
-        self._evictor: "collections.OrderedDict[int, None]" = collections.OrderedDict()
+        # Free-but-cached blocks in LRU order (oldest first), per region.
+        self._evictor: List["collections.OrderedDict[int, None]"] = [
+            collections.OrderedDict() for _ in range(num_regions)]
         # Per-request chain of block hashes (computed lazily).
         self._req_hashes: Dict[str, List[bytes]] = {}
+        self._region_of_req: Dict[str, int] = {}
 
         self.on_block_stored: List[BlockEvent] = []      # KV events / offload
         self.on_block_removed: List[BlockEvent] = []
         # Tiered cache: consulted on device-cache miss with (block_hash,
-        # protected chain blocks); returns a restored (cached,
-        # evictor-parked) block id or None (engine/offload.py).
+        # protected chain blocks, target region); returns a restored
+        # (cached, evictor-parked) block id in that region or None
+        # (engine/offload.py).
         self.secondary_lookup: Optional[
-            Callable[[bytes, frozenset], Optional[int]]] = None
+            Callable[[bytes, frozenset, int], Optional[int]]] = None
         self.eviction_count = 0
 
     # ---------- introspection ----------
 
+    def region_of_block(self, block_id: int) -> int:
+        return block_id // self.blocks_per_region
+
+    def local_block_id(self, block_id: int) -> int:
+        return block_id % self.blocks_per_region
+
+    def region_of_request(self, request: Request) -> int:
+        return self._region_of_req.get(request.request_id, 0)
+
     @property
     def num_free_blocks(self) -> int:
-        return len(self._free) + len(self._evictor)
+        return sum(len(f) for f in self._free) \
+            + sum(len(e) for e in self._evictor)
+
+    def region_free_blocks(self, region: int) -> int:
+        return len(self._free[region]) + len(self._evictor[region])
+
+    @property
+    def max_request_blocks(self) -> int:
+        """Largest block count any single request can ever hold (one
+        region's allocatable capacity)."""
+        return self.blocks_per_region - 1
 
     @property
     def usage(self) -> float:
-        usable = self.num_blocks - 1
+        usable = self.num_blocks - self.num_regions
         return 1.0 - self.num_free_blocks / usable if usable else 0.0
 
     # ---------- prefix cache ----------
@@ -83,22 +120,62 @@ class KVCacheManager:
             hashes.append(parent)
         return hashes[:n_full]
 
+    def assign_region(self, request: Request) -> int:
+        """Pin the request to a region: longest cached prefix chain wins
+        (the in-engine analogue of the EPP's prefix-affinity scorer),
+        tie-broken by most free blocks.  Idempotent per request."""
+        rid = request.request_id
+        r = self._region_of_req.get(rid)
+        if r is not None:
+            return r
+        if self.num_regions == 1:
+            self._region_of_req[rid] = 0
+            return 0
+        best_r, best_len = 0, -1
+        chain_region: Optional[int] = None
+        chain_len = 0
+        if self.enable_prefix_caching:
+            for h in self.request_block_hashes(request):
+                b = self._cached.get(h)
+                if b is None:
+                    break
+                reg = self.region_of_block(b)
+                if chain_region is None:
+                    chain_region = reg
+                elif reg != chain_region:
+                    break           # chain crosses regions: stop at boundary
+                chain_len += 1
+        for r in range(self.num_regions):
+            score = chain_len if r == chain_region else 0
+            if score > best_len or (
+                    score == best_len
+                    and self.region_free_blocks(r)
+                    > self.region_free_blocks(best_r)):
+                best_r, best_len = r, score
+        self._region_of_req[rid] = best_r
+        return best_r
+
     def find_cached_prefix(self, request: Request) -> Tuple[List[int], int]:
-        """Longest cached block-prefix for this request.
+        """Longest cached block-prefix for this request within its region.
 
         Returns (block_ids, num_cached_tokens). Does NOT take refs yet —
         call ``allocate`` with these as ``reuse_blocks``.
         """
         if not self.enable_prefix_caching:
             return [], 0
+        region = self.assign_region(request)
         blocks: List[int] = []
         for h in self.request_block_hashes(request):
             b = self._cached.get(h)
+            if b is not None and self.region_of_block(b) != region:
+                b = None            # foreign-shard block: unusable here
             if b is None and self.secondary_lookup is not None:
                 # Host-tier restore on miss; earlier chain blocks are
                 # refcount-0 evictor residents and must not be reused as
                 # the restore target (silent chain corruption).
-                b = self.secondary_lookup(h, frozenset(blocks))
+                b = self.secondary_lookup(h, frozenset(blocks), region)
+                if b is not None and self.region_of_block(b) != region:
+                    b = None
             if b is None:
                 break
             blocks.append(b)
@@ -114,20 +191,23 @@ class KVCacheManager:
 
     # ---------- allocation ----------
 
-    def _take_free_block(self) -> Optional[int]:
-        return self.take_block()
+    def _take_free_block(self, region: int = 0) -> Optional[int]:
+        return self.take_block(region=region)
 
-    def take_block(self, protected: frozenset = frozenset()) -> Optional[int]:
-        """Claim a block: plain free first, else evict the LRU cached block
-        not in ``protected`` (the offload tier protects the prefix chain it
-        is mid-way through assembling)."""
-        while self._free:
-            b = self._free.popleft()
-            if b not in self._evictor:      # plain free block
+    def take_block(self, protected: frozenset = frozenset(),
+                   region: int = 0) -> Optional[int]:
+        """Claim a block in ``region``: plain free first, else evict the LRU
+        cached block not in ``protected`` (the offload tier protects the
+        prefix chain it is mid-way through assembling)."""
+        free = self._free[region]
+        evictor = self._evictor[region]
+        while free:
+            b = free.popleft()
+            if b not in evictor:            # plain free block
                 return b
-        victim = next((b for b in self._evictor if b not in protected), None)
+        victim = next((b for b in evictor if b not in protected), None)
         if victim is not None:              # evict LRU cached block
-            del self._evictor[victim]
+            del evictor[victim]
             h = self._hash_of.pop(victim, None)
             if h is not None and self._cached.get(h) == victim:
                 del self._cached[h]
@@ -137,8 +217,14 @@ class KVCacheManager:
             return victim
         return None
 
-    def can_allocate(self, n: int) -> bool:
-        return self.num_free_blocks >= n
+    def can_allocate(self, n: int, region: Optional[int] = None) -> bool:
+        if region is None:
+            if self.num_regions == 1:
+                region = 0
+            else:
+                return max(self.region_free_blocks(r)
+                           for r in range(self.num_regions)) >= n
+        return self.region_free_blocks(region) >= n
 
     def allocate(self, request: Request, num_tokens_after: int,
                  reuse_blocks: Sequence[int] = ()) -> Optional[List[int]]:
@@ -148,6 +234,7 @@ class KVCacheManager:
         request currently holds no blocks). Returns newly attached block ids
         (reused + fresh), or None if not enough free blocks (caller preempts).
         """
+        region = self.assign_region(request)
         needed_blocks = -(-num_tokens_after // self.block_size)
         new_needed = needed_blocks - len(request.block_ids)
         if new_needed <= 0:
@@ -157,16 +244,17 @@ class KVCacheManager:
             assert not request.block_ids
             attach.extend(reuse_blocks)
             new_needed -= len(reuse_blocks)
-        if new_needed > 0 and len(self._free) + len(self._evictor) - sum(
-                1 for b in attach if b in self._evictor) < new_needed:
+        evictor = self._evictor[region]
+        if new_needed > 0 and self.region_free_blocks(region) - sum(
+                1 for b in attach if b in evictor) < new_needed:
             return None
         # Take refs on reused blocks (possibly resurrecting from evictor).
         for b in attach:
-            if b in self._evictor:
-                del self._evictor[b]
+            if b in evictor:
+                del evictor[b]
             self._ref[b] = self._ref.get(b, 0) + 1
         for _ in range(max(0, new_needed)):
-            b = self._take_free_block()
+            b = self._take_free_block(region)
             if b is None:       # raced with evictor bookkeeping; roll back
                 for bb in attach:
                     self._release(bb)
@@ -181,15 +269,17 @@ class KVCacheManager:
         if self._ref[b] == 0:
             del self._ref[b]
             if self.enable_prefix_caching and b in self._hash_of:
-                self._evictor[b] = None     # keep cached, evict LRU later
+                # Keep cached, evict LRU later.
+                self._evictor[self.region_of_block(b)][b] = None
             else:
-                self._free.append(b)
+                self._free[self.region_of_block(b)].append(b)
 
     def free(self, request: Request) -> None:
         for b in reversed(request.block_ids):
             self._release(b)
         request.block_ids = []
         self._req_hashes.pop(request.request_id, None)
+        self._region_of_req.pop(request.request_id, None)
 
     def release_tail(self, request: Request, blocks: Sequence[int]) -> None:
         """Give back just-attached tail blocks (speculative over-allocation
@@ -205,9 +295,10 @@ class KVCacheManager:
         h = self._hash_of.pop(block_id, None)
         if h is not None and self._cached.get(h) == block_id:
             del self._cached[h]
-        if block_id in self._evictor:
-            del self._evictor[block_id]
-            self._free.append(block_id)
+        evictor = self._evictor[self.region_of_block(block_id)]
+        if block_id in evictor:
+            del evictor[block_id]
+            self._free[self.region_of_block(block_id)].append(block_id)
 
     # ---------- post-step caching ----------
 

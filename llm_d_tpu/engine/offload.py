@@ -150,10 +150,24 @@ class HostKVTier:
         """Batched device->host copy of this step's newly cached blocks.
 
         Called by the engine at the end of each step, before the blocks'
-        contents can be overwritten by reuse."""
+        contents can be overwritten by reuse.  Stacked caches (SPMD dp)
+        group the batch by KV shard and gather each shard's plane."""
         if not self._pending:
             return
         pending, self._pending = self._pending, []
+        e = self.engine
+        km = e.kv_manager
+        if getattr(e, "dp", 1) > 1:
+            by_shard: Dict[int, list] = {}
+            for h, b in pending:
+                by_shard.setdefault(km.region_of_block(b), []).append((h, b))
+            for shard, group in by_shard.items():
+                self._flush_group(
+                    [(h, km.local_block_id(b)) for h, b in group], shard)
+        else:
+            self._flush_group(pending, None)
+
+    def _flush_group(self, pending, shard) -> None:
         e = self.engine
         bs = e.config.block_size
         nb = len(pending)
@@ -166,7 +180,11 @@ class HostKVTier:
         # One gather + device_get per cache buffer ({k, v} dense, {kv} MLA).
         hosts = {}
         for name, buf in _cache_items(e):
-            slab = _gather_fn(nb_pad, bs)(buf, ids_dev)
+            if shard is None:
+                slab = _gather_fn(nb_pad, bs)(buf, ids_dev)
+            else:
+                from llm_d_tpu.transfer.connector import _gather_fn_stacked
+                slab = _gather_fn_stacked(nb_pad, bs, shard)(buf, ids_dev)
             L, _, W = slab.shape
             hosts[name] = np.asarray(
                 jax.device_get(slab)).reshape(L, nb_pad, bs, W)
@@ -192,15 +210,17 @@ class HostKVTier:
     # ---------- host -> device (restore path) ----------
 
     def _restore(self, block_hash: bytes,
-                 protected: frozenset = frozenset()) -> Optional[int]:
+                 protected: frozenset = frozenset(),
+                 region: int = 0) -> Optional[int]:
         """Secondary prefix lookup: bring a host-tier block back on device.
 
-        Returns a device block id registered in the prefix cache (parked in
-        the evictor with refcount 0, exactly like a freed cached block), or
-        None when the tier misses too.  ``protected`` holds the chain's
-        already-matched blocks: they sit refcount-0 in the evictor and MUST
-        NOT be chosen as the restore target (overwriting one mid-lookup
-        would silently corrupt the very prefix being assembled)."""
+        Returns a device block id (in ``region`` — the requesting request's
+        KV shard) registered in the prefix cache (parked in the evictor with
+        refcount 0, exactly like a freed cached block), or None when the
+        tier misses too.  ``protected`` holds the chain's already-matched
+        blocks: they sit refcount-0 in the evictor and MUST NOT be chosen
+        as the restore target (overwriting one mid-lookup would silently
+        corrupt the very prefix being assembled)."""
         blob = self._store.get(block_hash)
         if blob is None and self.peers:
             blob = self._fetch_from_peers(block_hash)
@@ -208,21 +228,28 @@ class HostKVTier:
             return None
         e = self.engine
         km = e.kv_manager
-        b = km.take_block(protected)
+        b = km.take_block(protected, region=region)
         if b is None:
             return None          # everything free is protected; recompute
         bs = e.config.block_size
+        stacked = getattr(e, "dp", 1) > 1
         items = _cache_items(e)
-        slab = _unpack_block_slab(blob, [n for n, _ in items],
-                                  items[0][1].shape[0], bs)
-        ids_dev = jax.numpy.asarray(np.asarray([b], np.int32))
+        L = items[0][1].shape[1] if stacked else items[0][1].shape[0]
+        slab = _unpack_block_slab(blob, [n for n, _ in items], L, bs)
+        local = km.local_block_id(b) if stacked else b
+        ids_dev = jax.numpy.asarray(np.asarray([local], np.int32))
         for name, arr in slab.items():
-            e.kv_cache[name] = _scatter_fn(1, bs)(
-                e.kv_cache[name], ids_dev, jax.numpy.asarray(arr))
+            if stacked:
+                from llm_d_tpu.transfer.connector import _scatter_fn_stacked
+                e.kv_cache[name] = _scatter_fn_stacked(1, bs, region)(
+                    e.kv_cache[name], ids_dev, jax.numpy.asarray(arr))
+            else:
+                e.kv_cache[name] = _scatter_fn(1, bs)(
+                    e.kv_cache[name], ids_dev, jax.numpy.asarray(arr))
         self._store.move_to_end(block_hash)
         km._hash_of[b] = block_hash
         km._cached[block_hash] = b
-        km._evictor[b] = None
+        km._evictor[km.region_of_block(b)][b] = None
         self.loads += 1
         e.metrics.kv_offload_loads.inc()
         return b

@@ -95,10 +95,16 @@ class Scheduler:
 
         Requests already scheduled in this pass are not eligible victims:
         freeing their blocks after they were appended to ``scheduled`` would
-        corrupt the batch the engine is about to build.
+        corrupt the batch the engine is about to build.  With KV regions
+        (SPMD dp) only same-region victims help — freeing a foreign shard's
+        blocks cannot satisfy ``needy``'s allocation.
         """
+        region = self.kv.region_of_request(needy)
         for victim in reversed(self.running):
             if victim is needy or victim.request_id in scheduled_ids:
+                continue
+            if self.kv.num_regions > 1 \
+                    and self.kv.region_of_request(victim) != region:
                 continue
             self.running.remove(victim)
             self.kv.free(victim)
@@ -135,7 +141,7 @@ class Scheduler:
             # pool can never run — fail it instead of livelocking with n=0
             # forever (has_work() true, no progress, no client error).
             needed = -(-(req.num_computed_tokens + n) // self.kv.block_size)
-            if needed > self.kv.num_blocks - 1:
+            if needed > self.kv.max_request_blocks:
                 self.running.remove(req)
                 self.kv.free(req)
                 req.state = RequestState.FINISHED_ABORTED
@@ -150,8 +156,9 @@ class Scheduler:
                 # Nothing to preempt: shrink the chunk to the blocks that are
                 # actually free so mid-prefill requests keep making progress
                 # (partial pools must not stall the pass).
-                fit = ((len(req.block_ids) + self.kv.num_free_blocks)
-                       * self.kv.block_size) - req.num_computed_tokens
+                fit = ((len(req.block_ids) + self.kv.region_free_blocks(
+                    self.kv.region_of_request(req)))
+                    * self.kv.block_size) - req.num_computed_tokens
                 if fit >= n:        # bookkeeping race; bail out of this req
                     n = 0
                     break
@@ -164,7 +171,8 @@ class Scheduler:
                 # unless blocks are pinned outside the scheduler (PD transfer
                 # in flight), whose async release will unblock us.
                 if not scheduled and len(self.running) == 1 \
-                        and not self.kv.can_allocate(1) \
+                        and not self.kv.can_allocate(
+                            1, self.kv.region_of_request(req)) \
                         and self.external_pinned_blocks() == 0:
                     self.running.remove(req)
                     self.kv.free(req)
@@ -208,7 +216,7 @@ class Scheduler:
                 req.num_computed_tokens = 0
                 # First chunk alone exceeding the whole pool can never be
                 # admitted — fail it rather than blocking the queue forever.
-                if -(-n // self.kv.block_size) > self.kv.num_blocks - 1:
+                if -(-n // self.kv.block_size) > self.kv.max_request_blocks:
                     self.waiting.remove(req)
                     req.state = RequestState.FINISHED_ABORTED
                     preempted.append(req)

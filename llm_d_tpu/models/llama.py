@@ -114,19 +114,36 @@ def forward(
     """One engine step over a ragged batch.
 
     Returns (hidden states for sampling positions [S, D], updated kv cache).
+
+    SPMD dp (stacked mode): when batch arrays carry a leading [dp] dim
+    (``token_ids.ndim == 2``), attention runs per dp shard under
+    ``parallel.dp_attention.dp_attend`` and the sample gather is batched —
+    returns [dp, S_l, D].  Everything else is shape-polymorphic over the
+    leading dim.
     """
     c = config
-    x = params["embed"][batch["token_ids"]]          # [T, D]
+    stacked = batch["token_ids"].ndim == 2
+    x = params["embed"][batch["token_ids"]]          # [T, D] / [dp, T_l, D]
 
     # The FULL stacked KV cache rides the scan carry and each layer updates
     # its plane in place (Pallas aliasing / scatter-at-layer): slicing the
     # cache into per-layer xs/ys moved 2x the whole cache through HBM every
     # step (~10 ms at 1B scale) — the dominant decode cost before this.
+    def attend(lp, hn, caches, ab, li):
+        a, kv_k, kv_v = attention_block(
+            lp, c, hn, ab, caches[0], caches[1], block_size,
+            attn_backend, layer=li)
+        return a, (kv_k, kv_v)
+
     def layer_body(carry, lp):
         h, kv_k, kv_v, li = carry
-        a, kv_k, kv_v = attention_block(
-            lp, c, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps),
-            batch, kv_k, kv_v, block_size, attn_backend, layer=li)
+        hn = L.rms_norm(h, lp["input_norm"], c.rms_norm_eps)
+        if stacked:
+            from llm_d_tpu.parallel.dp_attention import dp_attend
+            a, (kv_k, kv_v) = dp_attend(
+                attend, mesh, lp, hn, (kv_k, kv_v), batch, li)
+        else:
+            a, (kv_k, kv_v) = attend(lp, hn, (kv_k, kv_v), batch, li)
         h = h + a
         m = L.swiglu_mlp(
             L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps),
@@ -140,7 +157,11 @@ def forward(
 
     x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
     # Only sampling positions need logits: gather last-token rows per sequence.
-    sample_hidden = x[batch["sample_idx"]]           # [S, D]
+    if stacked:
+        sample_hidden = jnp.take_along_axis(
+            x, batch["sample_idx"][..., None], axis=1)   # [dp, S_l, D]
+    else:
+        sample_hidden = x[batch["sample_idx"]]           # [S, D]
     return sample_hidden, {"k": k_new, "v": v_new}
 
 

@@ -122,28 +122,43 @@ def forward(
 ):
     c = config
     Ld = c.first_dense_layers
-    x = params["embed"][batch["token_ids"]]
+    stacked = batch["token_ids"].ndim == 2
+    x = params["embed"][batch["token_ids"]]   # [T, D] / [dp, T_l, D]
     cache_keys = ("kv",) if c.use_mla else ("k", "v")
     # DBO threshold by phase: the program's query width is static under jit,
     # and Q == 1 holds exactly for pure-decode programs (single-step or
     # fused).  None (no opts) lets the op consult its standalone env vars;
     # -1 disables DBO outright.
-    is_decode = batch["qtok_idx"].shape[1] == 1
+    is_decode = batch["qtok_idx"].shape[-1] == 1
     dbo_min_tokens = (moe_opts or {}).get(
         "dbo_decode_min_tokens" if is_decode else "dbo_prefill_min_tokens")
 
-    def attend(lp, hn, caches, li):
+    def attend_local(lp, hn, caches, ab, li):
         """Attention dispatch: MLA (single latent buffer) or classic GQA."""
         if c.use_mla:
             from llm_d_tpu.models.mla import mla_attention_block
             a, kv = mla_attention_block(
-                lp, c, hn, batch, caches[0], block_size, attn_backend,
+                lp, c, hn, ab, caches[0], block_size, attn_backend,
                 layer=li)
             return a, (kv,)
         a, kv_k, kv_v = attention_block(
-            lp, c, hn, batch, caches[0], caches[1], block_size,
+            lp, c, hn, ab, caches[0], caches[1], block_size,
             attn_backend, layer=li)
         return a, (kv_k, kv_v)
+
+    def attend(lp, hn, caches, li):
+        """Stacked mode: per-dp-shard attention (manual dp, auto tp) —
+        the dp half of the wide-EP regime; see parallel.dp_attention."""
+        if stacked:
+            from llm_d_tpu.parallel.dp_attention import dp_attend
+            return dp_attend(attend_local, mesh, lp, hn, caches, batch, li)
+        return attend_local(lp, hn, caches, batch, li)
+
+    def moe_tokens(hn):
+        """[dp, T_l, D] -> [dp*T_l, D] for EP dispatch: the merged token
+        dim stays dp-sharded (row-major reshape is shard-local), so the
+        a2a's in_specs re-slice only within each dp group."""
+        return hn.reshape(-1, hn.shape[-1]) if stacked else hn
 
     # Full stacked KV cache rides both scans' carries; each layer updates its
     # plane in place (see models.llama.forward) — no split/concat copies.
@@ -163,8 +178,9 @@ def forward(
             lp, L.rms_norm(h, lp["input_norm"], c.rms_norm_eps), caches, li)
         h = h + a
         hn = L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps)
+        ht = moe_tokens(hn)                       # [T, D] (dp-sharded rows)
         weights, idx = moe_ops.route(
-            jnp.dot(hn.astype(jnp.float32), lp["router"]), c,
+            jnp.dot(ht.astype(jnp.float32), lp["router"]), c,
             e_bias=lp.get("e_bias"))
         if "replica_table" in lp:
             # EPLB: route to a physical replica of the logical expert
@@ -185,8 +201,10 @@ def forward(
             quant = None
             w_gate, w_up, w_down = lp["w_gate"], lp["w_up"], lp["w_down"]
         m = moe_ops.expert_ffn(
-            hn, weights, phys_idx, w_gate, w_up, w_down, mesh=mesh,
+            ht, weights, phys_idx, w_gate, w_up, w_down, mesh=mesh,
             dbo_min_tokens=dbo_min_tokens, quant=quant)
+        if stacked:
+            m = m.reshape(hn.shape)
         if "shared_gate" in lp:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])
@@ -207,7 +225,11 @@ def forward(
         moe_body, (x, caches, li), moe_scan_params)
 
     x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
-    sample_hidden = x[batch["sample_idx"]]
+    if stacked:
+        sample_hidden = jnp.take_along_axis(
+            x, batch["sample_idx"][..., None], axis=1)   # [dp, S_l, D]
+    else:
+        sample_hidden = x[batch["sample_idx"]]
     out_cache = dict(zip(cache_keys, caches))
     if collect_routed:
         # [Lm, T, k] logical ids for the engine's EPLB LoadTracker.

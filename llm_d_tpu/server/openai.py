@@ -387,7 +387,47 @@ def build_server(engine_config: EngineConfig, tokenizer_name: Optional[str] = No
                        model_name or engine_config.resolve_model().name)
 
 
-def main(argv: Optional[List[str]] = None) -> None:
+def engine_config_from_args(args) -> EngineConfig:
+    """Parsed CLI flags -> EngineConfig (shared by ``main`` and the
+    multi-chip dryrun, so deploy manifests' flags are validated through the
+    SAME path the server uses).
+
+    Parallelism mapping: ``--data-parallel-mode spmd`` (default) builds ONE
+    (dp, tp) mesh — the wide-EP regime where MoE experts shard over all
+    dp*tp devices (reference: wide-ep decode.yaml:76,87-93); ``ranks``
+    keeps dp out of the mesh (DPEngineGroup places per-rank tp submeshes).
+    """
+    from llm_d_tpu.parallel.mesh import MeshConfig
+    dp = args.data_parallel_size
+    tp = args.tensor_parallel_size
+    if dp > 1 and args.data_parallel_mode == "spmd":
+        mesh = MeshConfig(dp=dp, tp=tp)
+    elif tp > 1:
+        mesh = MeshConfig(tp=tp)
+    else:
+        mesh = None
+    return EngineConfig(
+        model=args.model, block_size=args.block_size,
+        num_blocks=args.num_blocks, max_num_seqs=args.max_num_seqs,
+        max_num_batched_tokens=args.max_num_batched_tokens,
+        mesh=mesh,
+        allow_device_subset=args.allow_device_subset,
+        num_scheduler_steps=args.num_scheduler_steps,
+        async_scheduling=args.async_scheduling,
+        kv_offload_blocks=args.kv_offload_blocks,
+        kv_shared_tier_port=args.kv_shared_tier_port,
+        kv_shared_tier_peers=tuple(
+            s.strip() for s in args.kv_shared_tier_peers.split(",")
+            if s.strip()),
+        quantization=args.quantization,
+        enable_dbo=args.enable_dbo,
+        dbo_decode_token_threshold=args.dbo_decode_token_threshold,
+        dbo_prefill_token_threshold=args.dbo_prefill_token_threshold,
+        enable_eplb=args.enable_eplb,
+        eplb_config=json.loads(args.eplb_config) if args.eplb_config else None)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("llmd-serve")
     p.add_argument("--config", default=None,
                    help="YAML config file (keys = these flags); layered "
@@ -409,6 +449,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--max-num-batched-tokens", type=int, default=2048)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument(
+        "--data-parallel-mode", choices=["spmd", "ranks"], default="spmd",
+        help="spmd (default): ONE engine over a (dp, tp) device mesh — "
+             "attention/KV shard per dp group, MoE experts shard over ALL "
+             "dp*tp devices (expert HBM 1/EP: the wide-EP regime, "
+             "reference decode.yaml:76,87-93).  ranks: N independent "
+             "engine cores on disjoint tp submeshes behind a local "
+             "least-loaded dispatcher (the reference's process-per-rank "
+             "DP shape; experts replicated per rank)")
     p.add_argument(
         "--num-scheduler-steps", type=int, default=1,
         help="fused decode steps per device program on pure-decode rounds; "
@@ -480,6 +529,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         "--pod-identity", default=None,
         help="this replica's address as the EPP sees it (host:port); "
              "defaults to <host>:<port>")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = build_arg_parser()
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)   # before any startup logs
     if args.config or args.config_overlay:
@@ -500,36 +554,19 @@ def main(argv: Optional[List[str]] = None) -> None:
                           args.compilation_cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-    from llm_d_tpu.parallel.mesh import MeshConfig, maybe_init_distributed
+    from llm_d_tpu.parallel.mesh import maybe_init_distributed
     # Multi-host TPU slice: join the process group before touching devices
     # (LWS env contract; deploy/wide-ep-lws/decode-lws.yaml).
     if maybe_init_distributed():
         logger.info("joined LWS process group: %d hosts",
                     int(__import__("os").environ.get("LWS_GROUP_SIZE", "1")))
-    cfg = EngineConfig(
-        model=args.model, block_size=args.block_size,
-        num_blocks=args.num_blocks, max_num_seqs=args.max_num_seqs,
-        max_num_batched_tokens=args.max_num_batched_tokens,
-        mesh=MeshConfig(tp=args.tensor_parallel_size)
-        if args.tensor_parallel_size > 1 else None,
-        allow_device_subset=args.allow_device_subset,
-        num_scheduler_steps=args.num_scheduler_steps,
-        async_scheduling=args.async_scheduling,
-        kv_offload_blocks=args.kv_offload_blocks,
-        kv_shared_tier_port=args.kv_shared_tier_port,
-        kv_shared_tier_peers=tuple(
-            s.strip() for s in args.kv_shared_tier_peers.split(",")
-            if s.strip()),
-        quantization=args.quantization,
-        enable_dbo=args.enable_dbo,
-        dbo_decode_token_threshold=args.dbo_decode_token_threshold,
-        dbo_prefill_token_threshold=args.dbo_prefill_token_threshold,
-        enable_eplb=args.enable_eplb,
-        eplb_config=json.loads(args.eplb_config) if args.eplb_config else None)
+    cfg = engine_config_from_args(args)
     engine = None
-    if args.data_parallel_size > 1:
+    if args.data_parallel_size > 1 and args.data_parallel_mode == "ranks":
         # DP = per-rank engine cores over disjoint tp-submeshes behind a
         # local least-loaded dispatcher (reference: decode.yaml:73-93).
+        # (spmd mode needs no special engine: cfg.mesh carries the dp axis
+        # and EngineCore itself runs the stacked SPMD program.)
         from llm_d_tpu.engine.dp_group import DPEngineGroup
         engine = DPEngineGroup(cfg, dp_size=args.data_parallel_size)
     server = build_server(cfg, args.tokenizer, engine=engine)

@@ -292,25 +292,80 @@ def _scatter_fn(num_blocks: int, block_size: int):
     return scatter
 
 
+@functools.lru_cache(maxsize=32)
+def _gather_fn_stacked(num_blocks: int, block_size: int, shard: int):
+    """Stacked (SPMD dp) cache: gather blocks from one shard's plane.
+
+    The shard index is baked into the jitted program so XLA fuses the
+    plane slice into the gather — slicing ``buf[shard]`` OUTSIDE jit would
+    materialize the whole multi-GB plane to move a handful of blocks."""
+    @jax.jit
+    def gather(buf, block_ids):
+        slots = (block_ids[:, None] * block_size
+                 + jnp.arange(block_size, dtype=jnp.int32)[None, :]).reshape(-1)
+        return buf[shard][:, slots, :]            # [L, nb*bs, W]
+    return gather
+
+
+@functools.lru_cache(maxsize=32)
+def _scatter_fn_stacked(num_blocks: int, block_size: int, shard: int):
+    """Stacked (SPMD dp) cache: write one shard's plane in place.
+
+    NOTE: ``buf.at[shard, :, slots, :]`` would MIX the scalar and array
+    advanced indices across the basic slice, moving the slots dim to the
+    front (numpy advanced-indexing rule) — update the plane with a single
+    advanced index instead."""
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scatter(buf, block_ids, slab):
+        slots = (block_ids[:, None] * block_size
+                 + jnp.arange(block_size, dtype=jnp.int32)[None, :]).reshape(-1)
+        plane = buf[shard].at[:, slots, :].set(slab)
+        return buf.at[shard].set(plane)
+    return scatter
+
+
 def _cache_items(engine):
     """Deterministically ordered cache buffers ({k, v} dense, {kv} MLA)."""
     return sorted(engine.kv_cache.items())
 
 
+def _resolve_blocks(engine, block_ids: List[int]):
+    """Global block ids -> (shard plane or None, shard-local ids).
+
+    Stacked caches (SPMD dp) hold [dp, L, slots_l, W]; a request's blocks
+    all live in ONE region by construction (engine.kv_cache regions), so a
+    transfer addresses a single plane.  The wire format stays identical
+    across dp configurations — only device addressing changes."""
+    dp = getattr(engine, "dp", 1)
+    if dp == 1:
+        return None, np.asarray(block_ids, np.int32)
+    B_l = engine.kv_manager.blocks_per_region
+    shards = {b // B_l for b in block_ids} or {0}
+    assert len(shards) == 1, f"transfer blocks span dp shards: {shards}"
+    r = shards.pop()
+    return r, np.asarray([b % B_l for b in block_ids], np.int32)
+
+
 def _pack_blocks(engine, block_ids: List[int]) -> bytes:
     bs = engine.config.block_size
     nb = len(block_ids)
+    shard, local_ids = _resolve_blocks(engine, block_ids)
     nb_pad = _next_pow2(max(nb, 1))
     ids = np.zeros(nb_pad, np.int32)   # pad gathers the null block; trimmed
-    ids[:nb] = block_ids
+    ids[:nb] = local_ids
     ids_dev = jnp.asarray(ids)
     items = _cache_items(engine)
-    L = items[0][1].shape[0]
+    L = items[0][1].shape[0] if shard is None else items[0][1].shape[1]
     parts = [_HEADER.pack(_MAGIC, L, bs, len(items), nb)]
     for _, buf in items:
-        slab = _gather_fn(nb_pad, bs)(buf, ids_dev)
+        if shard is None:
+            slab = _gather_fn(nb_pad, bs)(buf, ids_dev)
+            width = buf.shape[2]
+        else:
+            slab = _gather_fn_stacked(nb_pad, bs, shard)(buf, ids_dev)
+            width = buf.shape[3]
         host = np.asarray(jax.device_get(slab))[:, :nb * bs, :]
-        parts.append(_BUF_HEADER.pack(buf.shape[2]))
+        parts.append(_BUF_HEADER.pack(width))
         parts.append(host.tobytes())
     return b"".join(parts)
 
@@ -322,7 +377,8 @@ def _scatter_blocks(engine, block_ids: List[int], blob: bytes) -> None:
     if magic != _MAGIC:
         raise ValueError("bad magic")
     items = _cache_items(engine)
-    L = items[0][1].shape[0]
+    shard, local_ids = _resolve_blocks(engine, block_ids)
+    L = items[0][1].shape[0] if shard is None else items[0][1].shape[1]
     if (bL, bbs, n_bufs) != (L, bs, len(items)):
         raise ValueError(
             f"slab layout {(bL, bbs, n_bufs)} != cache layout "
@@ -333,19 +389,20 @@ def _scatter_blocks(engine, block_ids: List[int], blob: bytes) -> None:
     nb_pad = _next_pow2(max(nb, 1))
     if nb_pad != nb:
         # Padded scatter targets must be real, distinct slots: route the
-        # pad writes into the null block (block 0 is the trash block).
+        # pad writes into the null block (local block 0 is the trash block).
         ids = np.zeros(nb_pad, np.int32)
-        ids[:nb] = block_ids
+        ids[:nb] = local_ids
     else:
-        ids = np.asarray(block_ids, np.int32)
+        ids = local_ids
     ids_dev = jnp.asarray(ids)
     off = _HEADER.size
     for name, buf in items:
+        width_have = buf.shape[2] if shard is None else buf.shape[3]
         (width,) = _BUF_HEADER.unpack_from(blob, off)
         off += _BUF_HEADER.size
-        if width != buf.shape[2]:
+        if width != width_have:
             raise ValueError(
-                f"buffer {name!r}: slab width {width} != cache {buf.shape[2]}")
+                f"buffer {name!r}: slab width {width} != cache {width_have}")
         count = L * bnb * bs * width
         payload = np.frombuffer(blob, dtype=ml_dtypes.bfloat16,
                                 offset=off, count=count)
@@ -355,5 +412,6 @@ def _scatter_blocks(engine, block_ids: List[int], blob: bytes) -> None:
             pad = np.zeros((L, nb_pad * bs, width), ml_dtypes.bfloat16)
             pad[:, :nb * bs, :] = slab
             slab = pad
-        engine.kv_cache[name] = _scatter_fn(nb_pad, bs)(
-            buf, ids_dev, jnp.asarray(slab))
+        fn = (_scatter_fn(nb_pad, bs) if shard is None
+              else _scatter_fn_stacked(nb_pad, bs, shard))
+        engine.kv_cache[name] = fn(buf, ids_dev, jnp.asarray(slab))
