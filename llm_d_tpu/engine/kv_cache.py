@@ -121,9 +121,13 @@ class KVCacheManager:
         return hashes[:n_full]
 
     def assign_region(self, request: Request) -> int:
-        """Pin the request to a region: longest cached prefix chain wins
-        (the in-engine analogue of the EPP's prefix-affinity scorer),
-        tie-broken by most free blocks.  Idempotent per request."""
+        """Pin the request to a region: the cached-prefix-chain region wins
+        (the in-engine analogue of the EPP's prefix-affinity scorer) —
+        but ONLY while that region can still hold the request's remaining
+        fresh blocks; otherwise most-free wins.  A pin sticks while the
+        request holds blocks; ``unpin`` lets an unplaceable request be
+        re-routed on the next scheduling pass instead of starving the
+        queue head against one full region."""
         rid = request.request_id
         r = self._region_of_req.get(rid)
         if r is not None:
@@ -131,7 +135,6 @@ class KVCacheManager:
         if self.num_regions == 1:
             self._region_of_req[rid] = 0
             return 0
-        best_r, best_len = 0, -1
         chain_region: Optional[int] = None
         chain_len = 0
         if self.enable_prefix_caching:
@@ -145,15 +148,25 @@ class KVCacheManager:
                 elif reg != chain_region:
                     break           # chain crosses regions: stop at boundary
                 chain_len += 1
-        for r in range(self.num_regions):
-            score = chain_len if r == chain_region else 0
-            if score > best_len or (
-                    score == best_len
-                    and self.region_free_blocks(r)
-                    > self.region_free_blocks(best_r)):
-                best_r, best_len = r, score
+        most_free = max(range(self.num_regions), key=self.region_free_blocks)
+        best_r = most_free
+        if chain_region is not None and chain_len > 0:
+            fresh_needed = max(
+                0, -(-request.num_prompt_tokens // self.block_size)
+                - chain_len)
+            if self.region_free_blocks(chain_region) >= fresh_needed:
+                best_r = chain_region
         self._region_of_req[rid] = best_r
         return best_r
+
+    def unpin(self, request: Request) -> bool:
+        """Drop a block-less request's region pin so the next pass may
+        assign a different region (used after a failed first allocation —
+        affinity must not beat admission)."""
+        if request.block_ids:
+            return False
+        self._region_of_req.pop(request.request_id, None)
+        return True
 
     def find_cached_prefix(self, request: Request) -> Tuple[List[int], int]:
         """Longest cached block-prefix for this request within its region.

@@ -221,6 +221,10 @@ class Scheduler:
                     req.state = RequestState.FINISHED_ABORTED
                     preempted.append(req)
                     continue
+                # Drop the region pin (SPMD dp): prefix affinity must not
+                # pin the queue head to one full region while others idle —
+                # the next pass re-assigns by capacity.
+                self.kv.unpin(req)
                 break               # head-of-line: don't skip ahead of FIFO
             self.waiting.remove(req)
             self.running.append(req)

@@ -222,15 +222,23 @@ class TpuConnector:
         P = req.num_prompt_tokens
         bs = engine.config.block_size
         nb = -(-P // bs)
-        if not engine.kv_manager.can_allocate(nb):
+        # Gate against the request's OWN region (SPMD dp pins requests to a
+        # KV shard): a pool-wide can_allocate would pass while the pinned
+        # region stays full — gate and allocation must agree.  On failure
+        # the pin is dropped so the next poll may re-route by capacity.
+        km = engine.kv_manager
+        region = km.assign_region(req)
+        if not km.can_allocate(nb, region):
             # Cache pressure: hold the slab and retry next poll (the blocks
             # will free as running requests finish). Still abortable.
+            km.unpin(req)
             self._retry.append((req, blob))
             with self._inflight_mu:
                 self._pending_ids.add(req.request_id)
             return None
-        attached = engine.kv_manager.allocate(req, P)
+        attached = km.allocate(req, P)
         if attached is None:
+            km.unpin(req)
             self._retry.append((req, blob))
             with self._inflight_mu:
                 self._pending_ids.add(req.request_id)

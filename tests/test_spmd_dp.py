@@ -120,6 +120,35 @@ def test_region_prefix_affinity():
     assert n_cached == 12 and len(blocks) == 3
 
 
+def test_affinity_yields_to_capacity():
+    """Prefix affinity must not pin a request to a full region while other
+    regions idle (review finding: head-of-line starvation)."""
+    km = KVCacheManager(num_blocks=16, block_size=4, num_regions=2)
+    prompt = list(range(50, 62))
+    a = greedy_req("a", prompt)
+    km.allocate(a, 12)
+    region_a = km.region_of_request(a)
+    a.num_computed_tokens = 12
+    km.cache_full_blocks(a)
+    # Saturate region_a with a live request (blocks held, nothing free).
+    hog = greedy_req("hog", list(range(200, 216)))
+    km._region_of_req["hog"] = region_a
+    km.allocate(hog, 16)
+    assert km.region_free_blocks(region_a) < 3
+    km.free(a)   # A's cached blocks are evictable but region is full of hog
+    # New request with A's prefix: chain region lacks capacity for the
+    # fresh tail (4 fresh blocks needed, 3 free there) -> capacity wins.
+    b = greedy_req("b", prompt + list(range(300, 316)))   # 28 tok = 7 blocks
+    region_b = km.assign_region(b)
+    assert region_b != region_a
+    assert km.allocate(b, len(b.prompt_token_ids)) is not None
+    # And unpin() lets a block-less request be re-routed.
+    c = greedy_req("c", [1, 2, 3, 4])
+    km.assign_region(c)
+    assert km.unpin(c)
+    assert c.request_id not in km._region_of_req
+
+
 def test_stacked_offload_restore(devices):
     """Host-tier restore into a stacked cache (per-shard plane scatter)."""
     eng = make_engine("tiny", mesh=MeshConfig(dp=2, sp=1, tp=2),
