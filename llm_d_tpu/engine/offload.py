@@ -261,12 +261,14 @@ class HostKVTier:
         of recomputing a whole block's prefill; hits also enter the local
         host tier so chained lookups and re-requests stay local.  Returns
         the PACKED blob (validated)."""
+        import errno as _errno
         import time as _time
         e = self.engine
         key = _shared_key(block_hash)
         items = _cache_items(e)
         names = [n for n, _ in items]
-        L = items[0][1].shape[0]
+        stacked = getattr(e, "dp", 1) > 1
+        L = items[0][1].shape[1] if stacked else items[0][1].shape[0]
         bs = e.config.block_size
         now = _time.monotonic()
         for peer in self.peers:
@@ -283,13 +285,25 @@ class HostKVTier:
                 self._peer_health.pop(peer, None)
                 continue
             except (transport.TransferError, ValueError, OSError) as exc:
-                fails += 1
+                # Transport-level unreachability (refused / no route /
+                # timed out) means the PEER is down, not this block: trip
+                # straight into backoff so a dead peer costs ONE timeout
+                # instead of stalling the engine thread once per uncached
+                # block until the consecutive-failure limit.
+                conn_err = isinstance(exc, OSError) and exc.errno in (
+                    _errno.ECONNREFUSED, _errno.EHOSTUNREACH,
+                    _errno.ENETUNREACH, _errno.ETIMEDOUT)
+                conn_err = conn_err or isinstance(exc, TimeoutError) \
+                    or "timed out" in str(exc).lower() \
+                    or "refused" in str(exc).lower()
+                fails = self.PEER_FAILURE_LIMIT if conn_err else fails + 1
                 self._peer_health[peer] = (
                     fails, _time.monotonic() + self.PEER_BACKOFF_S)
                 log = (logger.warning
-                       if fails == self.PEER_FAILURE_LIMIT else logger.debug)
-                log("shared-tier peer %s failed (%d consecutive): %s",
-                    peer, fails, exc)
+                       if fails >= self.PEER_FAILURE_LIMIT else logger.debug)
+                log("shared-tier peer %s failed (%s): %s", peer,
+                    "unreachable, backing off" if conn_err
+                    else f"{fails} consecutive", exc)
                 continue
             self._peer_health.pop(peer, None)
             self.remote_hits += 1

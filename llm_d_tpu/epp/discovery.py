@@ -19,9 +19,10 @@ Three resolvers cover the deployment spectrum:
                           EndpointSlices for a Service via the in-cluster
                           API (serviceaccount token), the same object
                           stream the reference's InferencePool controller
-                          consumes.  Picks up `ready` conditions, so
-                          unready pods leave the candidate set before they
-                          black-hole requests.
+                          consumes.  Returns ALL addresses regardless of
+                          the `ready` condition (see the class docstring
+                          for why); candidacy is decided by the
+                          Datastore's own ``/metrics`` scrape health.
 
 The Datastore reconciles each resolve tick: surviving addresses keep their
 scraped state (prefix-affinity continuity), new ones join as not-ready

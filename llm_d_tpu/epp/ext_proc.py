@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 from concurrent import futures
 from typing import Iterator, Optional
 
@@ -43,6 +44,59 @@ logger = logging.getLogger(__name__)
 
 SERVICE_NAME = "envoy.service.ext_proc.v3.ExternalProcessor"
 METHOD = "Process"
+
+
+class SyncFlowControl:
+    """Thread-safe bounded admission for the ext_proc plane (the gRPC
+    handler runs on a ThreadPool, not the asyncio loop, so it cannot share
+    ``service.FlowControl``).
+
+    Division of labor on this plane: UPSTREAM concurrency (requests in
+    flight at model servers) is Envoy's job — the deploy manifest sets
+    cluster ``circuit_breakers.max_requests``
+    (deploy/inference-scheduling/envoy-extproc.yaml) because the request
+    leaves the EPP's hands after the header mutation.  This gate bounds
+    concurrent SCHEDULING work plus a bounded wait, so a request flood
+    degrades to fast 429/503s at the EPP instead of unbounded thread/queue
+    growth — the same contract as the HTTP gateway's FlowControl."""
+
+    def __init__(self, max_inflight: int, max_queue: int,
+                 queue_timeout_s: float) -> None:
+        self.max_inflight = max_inflight
+        self.max_queue = max_queue
+        self.queue_timeout_s = queue_timeout_s
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._inflight = 0
+        self._queued = 0
+
+    def acquire(self, sheddable: bool) -> str:
+        """"ok" (slot held), "saturated" (sheddable), "queue_full",
+        or "timeout"."""
+        with self._cv:
+            if self._inflight < self.max_inflight and self._queued == 0:
+                self._inflight += 1
+                return "ok"
+            if sheddable:
+                return "saturated"
+            if self._queued >= self.max_queue:
+                return "queue_full"
+            self._queued += 1
+            try:
+                ok = self._cv.wait_for(
+                    lambda: self._inflight < self.max_inflight,
+                    timeout=self.queue_timeout_s)
+                if not ok:
+                    return "timeout"
+                self._inflight += 1
+                return "ok"
+            finally:
+                self._queued -= 1
+
+    def release(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify()
 
 
 def _immediate(code: int, message: str) -> pb.ProcessingResponse:
@@ -81,8 +135,10 @@ def _route_response(headers: dict,
 class ExtProcHandler:
     """One instance per EPP process; a stream per proxied HTTP request."""
 
-    def __init__(self, scheduler: EppScheduler) -> None:
+    def __init__(self, scheduler: EppScheduler,
+                 flow: Optional[SyncFlowControl] = None) -> None:
         self.scheduler = scheduler
+        self.flow = flow
 
     def process(self, request_iterator: Iterator[pb.ProcessingRequest],
                 context: grpc.ServicerContext
@@ -128,9 +184,24 @@ class ExtProcHandler:
             return _immediate(400, f"invalid json: {exc}")
         try:
             ctx = RequestCtx.from_request(payload, headers)
+        except (TypeError, ValueError) as exc:
+            return _immediate(400, f"invalid request: {exc}")
+        if self.flow is not None:
+            verdict = self.flow.acquire(sheddable=ctx.priority < 0)
+            if verdict == "saturated":
+                self.scheduler.metrics.shed_total.inc()
+                return _immediate(429, "saturated: sheddable request")
+            if verdict == "queue_full":
+                return _immediate(429, "flow control queue full")
+            if verdict == "timeout":
+                return _immediate(503, "flow control queue timeout")
+        try:
             result = self.scheduler.schedule(ctx)
         except (TypeError, ValueError) as exc:
             return _immediate(400, f"invalid request: {exc}")
+        finally:
+            if self.flow is not None:
+                self.flow.release()
         if ctx.shed:
             self.scheduler.metrics.shed_total.inc()
             return _immediate(
@@ -150,9 +221,10 @@ class ExtProcHandler:
 
 
 def make_server(scheduler: EppScheduler, port: int,
-                host: str = "0.0.0.0", max_workers: int = 16) -> grpc.Server:
+                host: str = "0.0.0.0", max_workers: int = 16,
+                flow: Optional[SyncFlowControl] = None) -> grpc.Server:
     """Build (not start) the ext_proc gRPC server on ``host:port``."""
-    handler = ExtProcHandler(scheduler)
+    handler = ExtProcHandler(scheduler, flow=flow)
     rpc = grpc.stream_stream_rpc_method_handler(
         handler.process,
         request_deserializer=pb.ProcessingRequest.FromString,

@@ -343,9 +343,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     logging.basicConfig(level=logging.INFO)
     ext_server = None
     if args.ext_proc_port is not None:
-        from llm_d_tpu.epp.ext_proc import make_server as make_ext_proc
+        from llm_d_tpu.epp.ext_proc import (
+            SyncFlowControl, make_server as make_ext_proc)
+        # Same admission knobs as the HTTP plane (thread-safe counterpart;
+        # upstream concurrency is Envoy's circuit breakers' job there).
+        ext_flow = (SyncFlowControl(args.max_inflight, args.max_queue,
+                                    args.queue_timeout)
+                    if args.max_inflight > 0 else None)
         ext_server = make_ext_proc(gw.scheduler, args.ext_proc_port,
-                                   host=args.host)
+                                   host=args.host, flow=ext_flow)
         ext_server.start()
         logger.info("ext_proc gRPC serving on :%d", args.ext_proc_port)
     try:

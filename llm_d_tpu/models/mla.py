@@ -150,6 +150,24 @@ def mla_attention_block(
             batch["block_tables"], batch["seq_lens"],
             block_size=block_size, scale=scale, layer=layer)
         out_lat = out[batch["token_seq_ids"]][..., :R].astype(jnp.float32)
+    elif backend == "pallas" and qtok_idx.shape[1] > 1 \
+            and block_size % 16 == 0 and F_cache % 128 == 0:
+        # Prefill / mixed batches: MLA flash kernel — the latent page is
+        # DMA'd once per tile and serves both the score and value dots
+        # (ops/pallas/mla_prefill.py; the chunked XLA path below cost
+        # ~90% of the MoE prefill step, BENCH_r04 Weak #4).
+        from llm_d_tpu.ops.pallas.mla_prefill import mla_flash_prefill
+        kv_cache, _ = A.write_kv(
+            kv_cache, kv_cache, row.reshape(T, 1, F_cache),
+            row.reshape(T, 1, F_cache),
+            batch["slot_mapping"], layer=layer)
+        qs, q_pos = A.gather_per_seq_queries(
+            q_eff, batch["positions"], qtok_idx)            # [S, Q, H, F]
+        out_s = mla_flash_prefill(
+            qs, q_pos, kv_cache, batch["block_tables"], batch["seq_lens"],
+            block_size=block_size, scale=scale, layer=layer)
+        out_lat = out_s[batch["token_seq_ids"], batch["token_qpos"]]
+        out_lat = out_lat[..., :R].astype(jnp.float32)      # attended c_kv
     else:
         # KVH=1 (every head reads the same latent row); the v-cache aliases
         # the k-cache — attended "values" are the row's first R columns.

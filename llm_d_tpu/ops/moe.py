@@ -144,8 +144,26 @@ def _local_expert_ffn(
 
     wslot = (weights.reshape(S)[order]
              * is_local[order].astype(jnp.float32))[:, None]
-    out = jnp.zeros((T, H), jnp.float32).at[tok].add(y * wslot)
-    return out
+    return _unsort_combine(y * wslot, order, T, k)
+
+
+def _unsort_combine(y: jax.Array, order: jax.Array, T: int, k: int,
+                    dest: Optional[jax.Array] = None) -> jax.Array:
+    """Per-token combine WITHOUT a [T, H] scatter-add (XLA lowers big row
+    scatters to serialized updates on TPU): un-sort via the inverse
+    permutation (a cheap 1-D scatter + ONE fast row gather), then a
+    [T, k, H] reshape-sum.  ``y`` rows are already combine-weighted, laid
+    out in ``order``'s sorted layout — or, with ``dest``, in a padded
+    layout where sorted slot ``s`` lives at row ``dest[s]`` (the grouped
+    kernel's layout); the index composition stays int32-only."""
+    S = T * k
+    inv = jnp.zeros((S,), jnp.int32).at[order].set(
+        jnp.arange(S, dtype=jnp.int32))
+    src = inv if dest is None else dest[inv]
+    # f32 AFTER the gather (bf16 rows move at half the bytes); the k-sum
+    # accumulates in f32 either way.
+    contrib = y[src].astype(jnp.float32)      # [S, H] in flat (t, k) order
+    return contrib.reshape(T, k, -1).sum(axis=1)
 
 
 def _dense_expert_ffn(
@@ -183,6 +201,73 @@ def _dense_expert_ffn(
 # Below this many tokens the dense all-experts path beats ragged_dot on a
 # single shard (measured crossover on v5e; see _dense_expert_ffn).
 DENSE_DISPATCH_MAX_T = 512
+
+# int8 kernel routing: at or below this T the dense streaming kernel wins;
+# above it the grouped kernel computes S = T*k rows instead of T*E — E/k
+# times less MXU work once the op turns compute-bound (prefill regime).
+# Measured on v5e at deepseek-v3-bench shapes: decode bs256 (T=256) runs
+# 15.2k tok/s dense vs 14.5k grouped (sort/pad glue + small tiles eat the
+# FLOP win), while prefill chunks (T=8192) run 2.2x faster grouped.
+GROUPED_INT8_MIN_T = 256
+
+
+def _grouped_int8_kernel_path(x, weights, idx, quant: dict,
+                              row_tile: Optional[int] = None,
+                              interpret: bool = False):
+    """Sort/pad/scatter glue for the grouped int8 kernel.
+
+    Rows are sorted by expert and each expert's run padded to a
+    ``row_tile`` multiple so every kernel tile serves exactly one expert
+    (static grid, no ragged_dot).  Pad rows carry zero combine weight.
+    ``quant`` must carry STACKED [Lm, E, ...] payloads and a "layer"
+    plane index (the model's contract; see models/moe.py)."""
+    from llm_d_tpu.ops.pallas.moe_int8 import grouped_moe_int8
+    T, H = x.shape
+    k = idx.shape[1]
+    E = quant["w_gate_q"].shape[1]
+    S = T * k
+    if row_tile is None:
+        # Tiles below 128 rows starve the MXU (measured: rt=32 at bs256
+        # decode ran ~13% slower than the dense kernel despite 8x fewer
+        # FLOPs); 256 once the mean rows/expert supports it.
+        rt = 128 if S < E * 256 else 256
+    else:
+        rt = row_tile
+    # Static worst-case padding: every expert may round up to a tile, and
+    # S itself must round to a tile multiple (T*k need not be one).
+    S_pad = -(-S // rt) * rt + E * rt
+    flat = idx.reshape(S)
+    order = jnp.argsort(flat, stable=True)
+    eid_s = flat[order]
+    tok_s = order // k
+    counts = jnp.zeros(E, jnp.int32).at[flat].add(1)
+    padded = -(-counts // rt) * rt
+    offs = _excl_cumsum(padded)
+    rank = jnp.arange(S, dtype=jnp.int32) - _excl_cumsum(counts)[eid_s]
+    dest = offs[eid_s] + rank
+    # Row data moves by GATHER only: big [*, H] scatters lower to
+    # serialized updates on TPU, so the padded layout is built from 1-D
+    # index scatters (cheap) + row gathers.  Padded slots point at the
+    # appended zero row of x_ext and carry zero combine weight.
+    src = jnp.full((S_pad,), T, jnp.int32).at[dest].set(tok_s)
+    x_ext = jnp.concatenate(
+        [x.astype(jnp.bfloat16), jnp.zeros((1, H), jnp.bfloat16)])
+    x_pad = x_ext[src]                                    # [S_pad, H]
+    wslot_pad = jnp.zeros((S_pad, 1), jnp.float32).at[dest, 0].set(
+        weights.reshape(S)[order])
+    NT = S_pad // rt
+    bounds = jnp.cumsum(padded)
+    tile_expert = jnp.minimum(
+        jnp.searchsorted(bounds, jnp.arange(NT, dtype=jnp.int32) * rt,
+                         side="right"),
+        E - 1).astype(jnp.int32)
+    y_pad = grouped_moe_int8(
+        x_pad, wslot_pad, tile_expert, quant["layer"],
+        quant["w_gate_q"], quant["w_gate_s"],
+        quant["w_up_q"], quant["w_up_s"],
+        quant["w_down_q"], quant["w_down_s"],
+        row_tile=rt, interpret=interpret)
+    return _unsort_combine(y_pad, order, T, k, dest=dest).astype(x.dtype)
 
 
 def _dense_int8_kernel_path(x, weights, idx, quant: dict,
@@ -444,13 +529,21 @@ def expert_ffn(
     if mesh is None or mesh.devices.size == 1:
         if dispatch == "auto":
             dispatch = os.environ.get("LLMD_MOE_DISPATCH", "auto")
+        if quant is not None and jax.default_backend() == "tpu" \
+                and dispatch == "auto":
+            # int8 kernel routing (an EXPLICIT dispatch override still gets
+            # the classic dequant paths below — the A/B lever).
+            min_t = int(os.environ.get("LLMD_MOE_GROUPED_MIN_T",
+                                       str(GROUPED_INT8_MIN_T)))
+            if x.shape[0] <= min_t:
+                # Tiny batches: weight-bound; all-experts streaming wins.
+                return _dense_int8_kernel_path(x, weights, idx, quant)
+            # Compute-bound regime: grouped kernel does T*k rows, not T*E.
+            return _grouped_int8_kernel_path(x, weights, idx, quant)
         if dispatch == "auto":
             max_t = int(os.environ.get("LLMD_MOE_DENSE_MAX_T",
                                        str(DENSE_DISPATCH_MAX_T)))
             dispatch = "dense" if x.shape[0] <= max_t else "ragged"
-        if quant is not None and dispatch == "dense" \
-                and jax.default_backend() == "tpu":
-            return _dense_int8_kernel_path(x, weights, idx, quant)
         if quant is not None:
             w_gate, w_up, w_down = _dequant_layer(quant)
         if dispatch == "dense":

@@ -1,0 +1,194 @@
+"""Pallas TPU flash-prefill kernel for MLA's single latent buffer.
+
+The MLA prefill previously attended via the chunked XLA path
+(``ragged_paged_attention_chunked``), materializing [S, Q, H, kv_chunk]
+f32 score tensors in HBM — measured 5-10% MFU on the MoE bench while the
+dense Pallas prefill reached ~30% (BENCH_r04; round-4 verdict Weak #4).
+This kernel runs the flash recurrence in VMEM like
+``ops.pallas.flash_prefill``, specialized to weight-absorbed MLA
+(reference role: FlashInfer's prefill kernels behind vLLM MLA,
+/root/reference/docker/Dockerfile.cuda:57-58):
+
+  - MQA, not GQA: every head scores against the SAME latent row
+    (KVH = 1), so there is no zero-expansion trick — the fused-row query
+    tile [Qt*H, F] hits the page in one MXU dot.
+  - ONE page buffer: the latent page serves BOTH the score dot and the
+    value dot (values are the row's first kv_lora_rank columns; we
+    accumulate over the full padded F and let the caller slice), exactly
+    the single-DMA pattern of ``mla_attention.py``'s decode kernel —
+    half the DMA traffic of reusing the dense prefill kernel with
+    v_cache aliased to k_cache.
+
+Causality bounds the page loop per tile; pad query rows carry position
+-1 and produce zeros.  KV rows for the tokens being computed are
+scattered by the caller (write_kv) BEFORE the kernel runs — read-only,
+no aliasing contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _mla_prefill_kernel(
+    # scalar prefetch
+    block_tables_ref,   # [S, B] SMEM
+    seq_lens_ref,       # [S]    SMEM
+    layer_ref,          # [1]    SMEM
+    # inputs
+    q_ref,              # [1, Qt*H, F] VMEM (fused rows: slot-major, head-minor)
+    qpos_ref,           # [1, Qt*H, 1] VMEM i32 (position per row; pad -> -1)
+    kv_hbm,             # [L, num_slots, F] (ANY) — the latent paged cache
+    # outputs
+    o_ref,              # [1, Qt*H, F] VMEM
+    # scratch
+    kv_buf,             # [2, bs, F] VMEM — shared by score AND value dots
+    sems,               # [2] DMA semaphores
+    *,
+    block_size: int,
+    scale: float,
+):
+    s = pl.program_id(0)
+    bs = block_size
+    li = layer_ref[0]
+    seq_len = seq_lens_ref[s]
+
+    q_pos = qpos_ref[0]                                       # [R, 1] i32
+    qmax = jnp.max(q_pos)
+    # Causal bound: keys at positions > qmax never score for this tile.
+    live = jnp.minimum(seq_len, qmax + 1)
+    n_pages = pl.cdiv(jnp.maximum(live, 0), bs)
+
+    def page_dma(slot, j):
+        b = block_tables_ref[s, j]
+        start = pl.multiple_of(b * bs, bs)
+        return pltpu.make_async_copy(
+            kv_hbm.at[li, pl.ds(start, bs)], kv_buf.at[slot], sems.at[slot])
+
+    @pl.when(n_pages > 0)
+    def _():
+        page_dma(0, 0).start()
+
+    # bf16 operands, f32 accumulation (flash statistics stay f32).
+    q2 = (q_ref[0].astype(jnp.float32) * scale).astype(jnp.bfloat16)
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = j % 2
+
+        @pl.when(j + 1 < n_pages)
+        def _():
+            page_dma((j + 1) % 2, j + 1).start()
+
+        page_dma(slot, j).wait()
+        kv = kv_buf[slot]                                     # [bs, F] bf16
+        s_hb = jax.lax.dot_general(
+            q2, kv, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [R, bs]
+        key_pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (1, bs), 1)                            # [1, bs]
+        valid = (key_pos <= q_pos) & (key_pos < seq_len)      # [R, bs]
+        s_hb = jnp.where(valid, s_hb, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_hb, axis=-1, keepdims=True))
+        p = jnp.exp(s_hb - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # Value dot on the SAME page buffer — no second DMA.
+        pv = jax.lax.dot_general(
+            p.astype(jnp.bfloat16), kv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [R, F]
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    R, F = q_ref.shape[1], q_ref.shape[2]
+    init = (
+        jnp.full((R, 1), -1e29, jnp.float32),
+        jnp.zeros((R, 1), jnp.float32),
+        jnp.zeros((R, F), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pick_q_tile(Q: int, H: int, F: int) -> int:
+    """Largest q-tile whose f32 accumulator + query pair fits ~3 MB.
+
+    Tighter than the dense prefill's 6 MB: the MLA row F is wide (640 for
+    V3), and at the bench shape (H=16, F=640) the 6 MB tile put the scoped
+    stack 0.4 MB over the 16 MB VMEM limit."""
+    qt = Q
+    while qt > 8 and qt * H * F * 8 > (3 << 20) and qt % 2 == 0:
+        qt //= 2
+    return qt
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "scale", "interpret", "q_tile"))
+def mla_flash_prefill(
+    qs: jax.Array,            # [S, Q, H, F] per-seq padded absorbed queries
+    q_pos: jax.Array,         # [S, Q] i32 absolute positions (pad -> -1)
+    kv_cache: jax.Array,      # [L, num_slots, F] (or [num_slots, F])
+    block_tables: jax.Array,  # [S, B]
+    seq_lens: jax.Array,      # [S]
+    block_size: int,
+    scale: float,
+    layer: jax.Array | None = None,
+    interpret: bool = False,
+    q_tile: int | None = None,
+):
+    """Returns attended latent rows [S, Q, H, F] (cache already written).
+
+    The caller slices the first ``kv_lora_rank`` columns (attended values)
+    and absorbs W_uv, exactly as with the chunked path."""
+    S, Q, H, F = qs.shape
+    squeeze = kv_cache.ndim == 2
+    if squeeze:
+        kv_cache = kv_cache[None]
+    assert kv_cache.shape[2] == F, (kv_cache.shape, F)
+    Qt = q_tile if q_tile is not None else _pick_q_tile(Q, H, F)
+    if Q % Qt:
+        raise ValueError(f"q_tile={Qt} must divide Q={Q}")
+    layer_arr = jnp.asarray([0 if layer is None else layer], jnp.int32)
+
+    # Fused row space (slot-major, head-minor), shaped OUTSIDE the kernel so
+    # Mosaic never sees a vector reshape.
+    q_fused = qs.reshape(S, Q * H, F)
+    qpos_fused = jnp.repeat(q_pos, H, axis=1)[..., None]      # [S, Q*H, 1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, Q // Qt),
+        in_specs=[
+            pl.BlockSpec((1, Qt * H, F), lambda s, t, *_: (s, t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Qt * H, 1), lambda s, t, *_: (s, t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Qt * H, F), lambda s, t, *_: (s, t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, F), kv_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(
+        _mla_prefill_kernel, block_size=block_size, scale=scale)
+    (out,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((S, Q * H, F), qs.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(block_tables, seq_lens, layer_arr, q_fused, qpos_fused, kv_cache)
+    return out.reshape(S, Q, H, F)

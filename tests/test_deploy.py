@@ -155,14 +155,17 @@ def test_wide_ep_manifests_request_spmd_wide_ep():
     default) so experts shard over every device in the LWS group."""
     for name in ("decode-lws.yaml", "prefill-lws.yaml"):
         path = os.path.join(REPO, "deploy", "wide-ep-lws", name)
+        matched = 0
         for p, c, devices in _engine_containers_with_topology():
             if p != path:
                 continue
+            matched += 1
             args = c.get("args", [])
             assert _flag(args, "--data-parallel-size", 1) > 1, (p, args)
             assert "ranks" not in args, p   # spmd is the default mode
             assert devices == _flag(args, "--data-parallel-size", 1) \
                 * _flag(args, "--tensor-parallel-size", 1)
+        assert matched >= 1, f"no engine container found in {path}"
 
 
 def test_lws_bootstrap_env_contract():

@@ -163,3 +163,21 @@ def test_a2a_in_moe_model_forward(mesh):
             del os.environ["LLMD_MOE_DISPATCH"]
     np.testing.assert_allclose(outs["a2a"], outs["psum"],
                                atol=5e-2, rtol=5e-2)
+
+
+def test_a2a_matches_psum_oracle_fast(mesh):
+    """GATING-TIER parity representative (advisor r4): one tiny a2a-vs-psum
+    case so a dispatch-math regression cannot merge green; the full sweep
+    stays in the slow tier."""
+    from llm_d_tpu.models.config import ModelConfig
+    cfg = ModelConfig(name="a2a-fast", num_experts=8, num_experts_per_tok=2,
+                      moe_renormalize=True)
+    x, router, w_gate, w_up, w_down = _case(99, 16, 8)
+    weights, idx = _route(x, router, cfg)
+    psum = moe_ops.expert_ffn(x, weights, idx, w_gate, w_up, w_down,
+                              mesh=mesh, dispatch="psum")
+    a2a = moe_ops.expert_ffn(x, weights, idx, w_gate, w_up, w_down,
+                             mesh=mesh, dispatch="a2a")
+    np.testing.assert_allclose(np.asarray(a2a, np.float32),
+                               np.asarray(psum, np.float32),
+                               atol=3e-2, rtol=3e-2)

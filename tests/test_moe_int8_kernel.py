@@ -104,3 +104,66 @@ def test_engine_int8_uses_kernel_only_on_tpu():
     got = q.generate([req("b")])["b"]
     # int8 weight noise may flip late tokens; the first ones must agree.
     assert got[:2] == want[:2]
+
+
+@pytest.mark.parametrize("T,E,H,I,rt", [
+    (16, 8, 256, 128, 8),     # tiny rows, small tile: heavy padding path
+    (64, 4, 512, 256, 16),    # multi-tile experts
+    (36, 8, 256, 128, 16),    # S = T*k NOT a tile multiple (r5 review fix)
+])
+def test_grouped_kernel_matches_dequant_oracle(T, E, H, I, rt):
+    """Grouped (sorted+padded) int8 path == routed dequant oracle.
+    Drives the ACTUAL glue (_grouped_int8_kernel_path: sort, pad,
+    tile_expert construction, scatter-add) in interpret mode."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    key = jax.random.PRNGKey(7)
+    ks = jax.random.split(key, 6)
+    k = 2
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jax.random.randint(ks[1], (T, k), 0, E)
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    wg_q, wg_s = quantize_int8(
+        jax.random.normal(ks[3], (E, H, I), jnp.float32) * 0.05)
+    wu_q, wu_s = quantize_int8(
+        jax.random.normal(ks[4], (E, H, I), jnp.float32) * 0.05)
+    wd_q, wd_s = quantize_int8(
+        jax.random.normal(ks[5], (E, I, H), jnp.float32) * 0.05)
+    stack = lambda a: jnp.stack([jnp.zeros_like(a), a])
+    quant = dict(w_gate_q=stack(wg_q), w_gate_s=stack(wg_s),
+                 w_up_q=stack(wu_q), w_up_s=stack(wu_s),
+                 w_down_q=stack(wd_q), w_down_s=stack(wd_s),
+                 layer=jnp.int32(1))
+
+    got = moe_ops._grouped_int8_kernel_path(
+        x, w, idx, quant, row_tile=rt, interpret=True)
+
+    g, u, d = (dequantize(wg_q, wg_s), dequantize(wu_q, wu_s),
+               dequantize(wd_q, wd_s))
+    want = moe_ops._local_expert_ffn(x, w, idx, g, u, d, jnp.int32(0))
+
+    scale = float(jnp.max(jnp.abs(np.asarray(want)))) + 1e-9
+    np.testing.assert_allclose(np.asarray(got) / scale,
+                               np.asarray(want) / scale, atol=8e-3)
+
+
+def test_grouped_kernel_routing_thresholds(monkeypatch):
+    """expert_ffn routes: T <= LLMD_MOE_GROUPED_MIN_T -> dense streaming
+    kernel; larger T -> grouped kernel (TPU backend only)."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    calls = []
+    monkeypatch.setattr(moe_ops, "_dense_int8_kernel_path",
+                        lambda x, *a, **kw: calls.append("dense") or x)
+    monkeypatch.setattr(moe_ops, "_grouped_int8_kernel_path",
+                        lambda x, *a, **kw: calls.append("grouped") or x)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    quant = dict(w_gate_q=jnp.zeros((1, 4, 8, 8), jnp.int8))
+    lo = moe_ops.GROUPED_INT8_MIN_T          # <= threshold -> dense
+    hi = 2 * moe_ops.GROUPED_INT8_MIN_T      # above -> grouped
+    for T in (lo, hi):
+        moe_ops.expert_ffn(jnp.ones((T, 8), jnp.bfloat16),
+                           jnp.ones((T, 2), jnp.float32),
+                           jnp.zeros((T, 2), jnp.int32),
+                           None, None, None, quant=quant)
+    assert calls == ["dense", "grouped"]
