@@ -42,11 +42,24 @@ class DPEngineGroup:
         params=None,
         metrics: Optional[EngineMetrics] = None,
         devices: Optional[List[jax.Device]] = None,
+        start_rank: int = 0,
     ) -> None:
+        """``start_rank`` is this host's first GLOBAL rank in a multi-host
+        DP deployment (reference: --data-parallel-start-rank arithmetic,
+        wide-ep decode.yaml:73,93).  It identifies the host's rank range
+        (``start_rank == 0`` is the leader that owns cross-host dispatch
+        — see server.openai's DPWorkerPool wiring); LOCAL per-rank
+        resources like shared-tier ports stay offset by the local rank
+        ``r`` — ports are a per-host namespace, so a global offset would
+        only desynchronize peer config across hosts.  Devices default to
+        the HOST's chips — multi-host ranks are independent per host,
+        never a slice-wide mesh."""
         assert dp_size >= 1
+        self.start_rank = start_rank
         tp = config.mesh.tp if config.mesh else 1
         sp = config.mesh.sp if config.mesh else 1
-        devices = list(devices if devices is not None else jax.devices())
+        devices = list(devices if devices is not None
+                       else jax.local_devices())
         per_rank = tp * sp
         if dp_size * per_rank != len(devices) and not config.allow_device_subset:
             raise ValueError(

@@ -221,9 +221,16 @@ class ExtProcHandler:
 
 
 def make_server(scheduler: EppScheduler, port: int,
-                host: str = "0.0.0.0", max_workers: int = 16,
+                host: str = "0.0.0.0", max_workers: Optional[int] = None,
                 flow: Optional[SyncFlowControl] = None) -> grpc.Server:
-    """Build (not start) the ext_proc gRPC server on ``host:port``."""
+    """Build (not start) the ext_proc gRPC server on ``host:port``.
+
+    Thread-pool sizing follows the flow-control knobs: the executor must
+    admit ``max_inflight + max_queue`` concurrent streams or the gate
+    never engages (handlers would queue in the executor AHEAD of it,
+    unbounded and unshed); ``maximum_concurrent_rpcs`` is the hard
+    backstop — streams beyond it get gRPC RESOURCE_EXHAUSTED instead of
+    growing the executor's internal queue."""
     handler = ExtProcHandler(scheduler, flow=flow)
     rpc = grpc.stream_stream_rpc_method_handler(
         handler.process,
@@ -231,9 +238,13 @@ def make_server(scheduler: EppScheduler, port: int,
         response_serializer=pb.ProcessingResponse.SerializeToString)
     service = grpc.method_handlers_generic_handler(
         SERVICE_NAME, {METHOD: rpc})
+    cap = (flow.max_inflight + flow.max_queue if flow is not None else 64)
+    if max_workers is None:
+        max_workers = cap
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers,
-                                   thread_name_prefix="ext-proc"))
+                                   thread_name_prefix="ext-proc"),
+        maximum_concurrent_rpcs=cap)
     server.add_generic_rpc_handlers((service,))
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:

@@ -149,12 +149,16 @@ def _prefill_kernel(
     o_ref[0] = out.astype(o_ref.dtype)
 
 
-def _pick_q_tile(Q: int, H: int, F: int) -> int:
-    """Largest q-tile whose f32 accumulator + query pair fits ~6 MB."""
-    qt = Q
-    while qt > 8 and qt * H * F * 8 > (6 << 20) and qt % 2 == 0:
-        qt //= 2
-    return qt
+def _pick_q_tile(Q: int, H: int, F: int, budget: int = 6 << 20) -> int:
+    """Largest DIVISOR of Q whose f32 accumulator + query pair fits the
+    VMEM budget (divisor search, not halving: Q buckets can be
+    non-powers-of-two when ``--max-num-batched-tokens`` clamps them, and
+    an odd-but-oversized tile would fail Mosaic compilation)."""
+    best = 1
+    for qt in range(1, Q + 1):
+        if Q % qt == 0 and qt * H * F * 8 <= budget:
+            best = qt
+    return best
 
 
 @functools.partial(

@@ -117,16 +117,21 @@ def _mla_prefill_kernel(
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _pick_q_tile(Q: int, H: int, F: int) -> int:
-    """Largest q-tile whose f32 accumulator + query pair fits ~3 MB.
+def _pick_q_tile(Q: int, H: int, F: int, budget: int = 3 << 20) -> int:
+    """Largest DIVISOR of Q whose f32 accumulator + query pair fits the
+    VMEM budget (~3 MB — tighter than the dense prefill's 6 MB: the MLA
+    row F is wide, 640 for V3, and at the bench shape H=16/F=640 a 6 MB
+    tile put the scoped stack 0.4 MB over the 16 MB limit).
 
-    Tighter than the dense prefill's 6 MB: the MLA row F is wide (640 for
-    V3), and at the bench shape (H=16, F=640) the 6 MB tile put the scoped
-    stack 0.4 MB over the 16 MB VMEM limit."""
-    qt = Q
-    while qt > 8 and qt * H * F * 8 > (3 << 20) and qt % 2 == 0:
-        qt //= 2
-    return qt
+    Divisor search, not halving: Q buckets can be non-powers-of-two
+    (``--max-num-batched-tokens`` clamps the bucket), and stopping at an
+    odd qt that is still 10x over budget would fail Mosaic compilation at
+    serve time."""
+    best = 1
+    for qt in range(1, Q + 1):
+        if Q % qt == 0 and qt * H * F * 8 <= budget:
+            best = qt
+    return best
 
 
 @functools.partial(

@@ -59,6 +59,81 @@ def _sampling_from_body(body: Dict[str, Any]) -> SamplingParams:
     )
 
 
+class DPWorkerPool:
+    """Leader-side cross-host dispatch for multi-host data parallelism
+    (ranks mode — the reference's ``--data-parallel-address`` / RPC-port
+    contract, wide-ep decode.yaml:89-93).
+
+    The leader host serves ALL external traffic; each request either runs
+    on the local ``DPEngineGroup`` or is proxied verbatim to a worker
+    host's API server (the "RPC" is the same OpenAI HTTP surface — one
+    wire format end to end).  Policy is least-outstanding-work: local load
+    from the engine's scheduler, worker load from the leader's own
+    in-flight proxy count.  With ``--data-parallel-hybrid-lb`` no pool
+    exists: every host takes external traffic and balances only its local
+    ranks (the external LB spreads hosts), decode.yaml:75,86.
+    """
+
+    WORKER_BACKOFF_S = 15.0
+
+    def __init__(self, workers: List[str]) -> None:
+        self.workers = [{"url": u.rstrip("/"), "inflight": 0, "down_until": 0.0}
+                        for u in workers if u.strip()]
+        self._session = None
+
+    def pick(self, engine) -> Optional[dict]:
+        """Returns the worker to proxy to, or None to serve locally.
+        Workers that recently failed to connect are skipped until their
+        backoff expires — a dead pod must not keep winning the
+        least-inflight race while its requests all 500."""
+        now = time.monotonic()
+        live = [w for w in self.workers if w["down_until"] <= now]
+        if not live:
+            return None
+        local = engine.scheduler.num_waiting + engine.scheduler.num_running
+        best = min(live, key=lambda w: w["inflight"])
+        return best if best["inflight"] < local else None
+
+    async def proxy(self, request: web.Request, body: Dict[str, Any],
+                    worker: dict) -> Optional[web.StreamResponse]:
+        """Stream-through proxy of one inference request to a worker.
+
+        Returns None when the worker was unreachable BEFORE any response
+        bytes were committed — the caller falls back to serving locally
+        (mid-stream failures must propagate: bytes already left)."""
+        import aiohttp
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=5))
+        worker["inflight"] += 1
+        resp = None
+        try:
+            async with self._session.post(
+                    worker["url"] + request.path, json=body) as upstream:
+                resp = web.StreamResponse(
+                    status=upstream.status,
+                    headers={"Content-Type": upstream.headers.get(
+                        "Content-Type", "application/json")})
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+            worker["down_until"] = time.monotonic() + self.WORKER_BACKOFF_S
+            logger.warning("DP worker %s unreachable (%s); backing off %.0fs",
+                           worker["url"], exc, self.WORKER_BACKOFF_S)
+            if resp is None:
+                return None          # nothing committed: serve locally
+            raise                    # mid-stream: the client sees the break
+        finally:
+            worker["inflight"] -= 1
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+
+
 class ModelServer:
     def __init__(self, engine: EngineCore, tokenizer, model_name: str) -> None:
         self.engine = engine
@@ -66,6 +141,8 @@ class ModelServer:
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.model_loaded = False
+        # Multi-host DP: leader-side worker pool (set by main / tests).
+        self.dp_pool: Optional[DPWorkerPool] = None
         self.started_at = time.time()
         if tokenizer.eos_token_id is not None:
             engine.eos_token_id = tokenizer.eos_token_id
@@ -97,6 +174,8 @@ class ModelServer:
         pub = getattr(self, "kv_event_publisher", None)
         if pub is not None:
             pub.stop()
+        if self.dp_pool is not None:
+            await self.dp_pool.close()
 
     # ---------- probes / meta ----------
 
@@ -152,6 +231,12 @@ class ModelServer:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
+        if self.dp_pool is not None:
+            worker = self.dp_pool.pick(self.engine)
+            if worker is not None:
+                proxied = await self.dp_pool.proxy(request, body, worker)
+                if proxied is not None:
+                    return proxied
         prompt = body.get("prompt", "")
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
             prompt_ids = prompt
@@ -164,6 +249,12 @@ class ModelServer:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
+        if self.dp_pool is not None:
+            worker = self.dp_pool.pick(self.engine)
+            if worker is not None:
+                proxied = await self.dp_pool.proxy(request, body, worker)
+                if proxied is not None:
+                    return proxied
         messages = body.get("messages", [])
         if hasattr(self.tokenizer, "_tok") and hasattr(
                 self.tokenizer._tok, "apply_chat_template"):
@@ -387,6 +478,21 @@ def build_server(engine_config: EngineConfig, tokenizer_name: Optional[str] = No
                        model_name or engine_config.resolve_model().name)
 
 
+def derive_dp_workers(leader_address: str, n_workers: int,
+                      rpc_port: int) -> List[str]:
+    """Worker base URLs from the LWS naming convention: the leader pod
+    ``<lws>-<g>`` has workers ``<lws>-<g>-<i>`` in the same headless
+    subdomain (reference start-rank arithmetic, decode.yaml:73,93)."""
+    host = leader_address
+    if "//" in host:
+        host = host.split("//", 1)[1]
+    host = host.split(":", 1)[0]
+    pod, dot, domain = host.partition(".")
+    suffix = f"{dot}{domain}" if dot else ""
+    return [f"http://{pod}-{i}{suffix}:{rpc_port}"
+            for i in range(1, n_workers + 1)]
+
+
 def engine_config_from_args(args) -> EngineConfig:
     """Parsed CLI flags -> EngineConfig (shared by ``main`` and the
     multi-chip dryrun, so deploy manifests' flags are validated through the
@@ -449,6 +555,38 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-num-batched-tokens", type=int, default=2048)
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--data-parallel-size", type=int, default=1)
+    p.add_argument(
+        "--data-parallel-size-local", type=int, default=None,
+        help="ranks mode, multi-host: DP ranks on THIS host (reference: "
+             "--data-parallel-size-local, wide-ep decode.yaml:90); "
+             "default = --data-parallel-size (single host)")
+    p.add_argument(
+        "--data-parallel-start-rank", type=int, default=None,
+        help="ranks mode, multi-host: first global rank on this host "
+             "(reference: --data-parallel-start-rank, decode.yaml:93); "
+             "default LWS_WORKER_INDEX * dp_size_local")
+    p.add_argument(
+        "--data-parallel-address", default=None,
+        help="leader host address (reference: --data-parallel-address, "
+             "decode.yaml:91); used to derive worker URLs under LWS when "
+             "--data-parallel-workers is not given")
+    p.add_argument(
+        "--data-parallel-rpc-port", type=int, default=None,
+        help="worker API port the leader dispatches to (reference: "
+             "--data-parallel-rpc-port, decode.yaml:92; here the RPC IS "
+             "the OpenAI HTTP surface); default --port")
+    p.add_argument(
+        "--data-parallel-hybrid-lb", action="store_true",
+        help="multi-host ranks mode: every host takes external traffic "
+             "and balances only its local ranks (external LB spreads "
+             "hosts); without it the leader (start rank 0) proxies to "
+             "worker hosts (reference: --data-parallel-hybrid-lb, "
+             "decode.yaml:75,86)")
+    p.add_argument(
+        "--data-parallel-workers", default="",
+        help="comma list of worker base URLs (http://host:port) for "
+             "leader-side dispatch; default derives from the LWS naming "
+             "convention")
     p.add_argument(
         "--data-parallel-mode", choices=["spmd", "ranks"], default="spmd",
         help="spmd (default): ONE engine over a (dp, tp) device mesh — "
@@ -554,12 +692,37 @@ def main(argv: Optional[List[str]] = None) -> None:
                           args.compilation_cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+    import os as _os
+
     from llm_d_tpu.parallel.mesh import maybe_init_distributed
-    # Multi-host TPU slice: join the process group before touching devices
-    # (LWS env contract; deploy/wide-ep-lws/decode-lws.yaml).
-    if maybe_init_distributed():
-        logger.info("joined LWS process group: %d hosts",
-                    int(__import__("os").environ.get("LWS_GROUP_SIZE", "1")))
+    dp_local = args.data_parallel_size_local or args.data_parallel_size
+    if dp_local > args.data_parallel_size \
+            or args.data_parallel_size % dp_local:
+        p.error(f"--data-parallel-size-local {dp_local} must divide "
+                f"--data-parallel-size {args.data_parallel_size}")
+    multi_host_ranks = (args.data_parallel_mode == "ranks"
+                       and dp_local < args.data_parallel_size)
+    if multi_host_ranks:
+        # Reference DP semantics: hosts run INDEPENDENT engine ranks (no
+        # slice-wide jax process group — each host's ranks live on its
+        # local chips); the LWS env only drives rank arithmetic + worker
+        # address derivation (decode.yaml:73,89-93).
+        start_rank = args.data_parallel_start_rank
+        if start_rank is None:
+            start_rank = int(
+                _os.environ.get("LWS_WORKER_INDEX", "0")) * dp_local
+        logger.info("multi-host DP: local ranks %d..%d of %d (%s)",
+                    start_rank, start_rank + dp_local - 1,
+                    args.data_parallel_size,
+                    "hybrid-lb" if args.data_parallel_hybrid_lb
+                    else "leader dispatch")
+    else:
+        start_rank = 0
+        # Multi-host TPU slice (spmd / tp): join the process group before
+        # touching devices (LWS env contract; deploy/wide-ep-lws).
+        if maybe_init_distributed():
+            logger.info("joined LWS process group: %d hosts",
+                        int(_os.environ.get("LWS_GROUP_SIZE", "1")))
     cfg = engine_config_from_args(args)
     engine = None
     if args.data_parallel_size > 1 and args.data_parallel_mode == "ranks":
@@ -567,9 +730,34 @@ def main(argv: Optional[List[str]] = None) -> None:
         # local least-loaded dispatcher (reference: decode.yaml:73-93).
         # (spmd mode needs no special engine: cfg.mesh carries the dp axis
         # and EngineCore itself runs the stacked SPMD program.)
+        import jax as _jax
+
         from llm_d_tpu.engine.dp_group import DPEngineGroup
-        engine = DPEngineGroup(cfg, dp_size=args.data_parallel_size)
+        engine = DPEngineGroup(cfg, dp_size=dp_local,
+                               devices=list(_jax.local_devices()),
+                               start_rank=start_rank)
     server = build_server(cfg, args.tokenizer, engine=engine)
+    if multi_host_ranks and not args.data_parallel_hybrid_lb \
+            and start_rank == 0:
+        # Leader-side cross-host dispatch over the OpenAI HTTP surface.
+        workers = [w.strip() for w in args.data_parallel_workers.split(",")
+                   if w.strip()]
+        if not workers:
+            leader = (args.data_parallel_address
+                      or _os.environ.get("LWS_LEADER_ADDRESS", ""))
+            n_hosts = args.data_parallel_size // dp_local
+            rpc_port = args.data_parallel_rpc_port or args.port
+            if leader:
+                workers = derive_dp_workers(leader, n_hosts - 1, rpc_port)
+        if workers:
+            server.dp_pool = DPWorkerPool(workers)
+            logger.info("DP leader dispatching across %d worker hosts: %s",
+                        len(workers), workers)
+        else:
+            logger.warning(
+                "multi-host DP leader has no worker addresses (pass "
+                "--data-parallel-workers or run under LWS); serving "
+                "local ranks only")
     if args.latency_training_url:
         server.latency_training_url = args.latency_training_url.rstrip("/")
     if args.kv_transfer_config:

@@ -1,0 +1,165 @@
+"""Multi-host data parallelism (ranks mode): leader dispatch + start-rank
+arithmetic (reference: --data-parallel-{size-local,start-rank,address,
+rpc-port,hybrid-lb}, wide-ep decode.yaml:73,86-93).
+
+Two API servers with DISJOINT per-host rank groups (leader: devices 0-1,
+worker: devices 2-3 of the virtual CPU mesh — the two-host shape in one
+process), the leader proxying over the OpenAI HTTP surface exactly as the
+LWS leader does to worker pods.
+"""
+
+import asyncio
+import socket
+import threading
+
+import jax
+import pytest
+import requests
+
+from llm_d_tpu.engine.dp_group import DPEngineGroup
+from llm_d_tpu.engine.engine import EngineConfig
+from llm_d_tpu.parallel.mesh import MeshConfig
+from llm_d_tpu.server.openai import (
+    DPWorkerPool, build_arg_parser, build_server, derive_dp_workers)
+
+ENGINE_KW = dict(model="tiny", block_size=4, num_blocks=64, max_num_seqs=8,
+                 max_num_batched_tokens=64, min_token_bucket=16,
+                 min_seq_bucket=4, allow_device_subset=True)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start(server, port):
+    from aiohttp import web
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(30)
+    for _ in range(100):
+        try:
+            if requests.get(f"http://127.0.0.1:{port}/health",
+                            timeout=1).status_code == 200:
+                break
+        except requests.ConnectionError:
+            pass
+        import time
+        time.sleep(0.1)
+
+
+@pytest.fixture(scope="module")
+def two_hosts(devices):
+    cfg = EngineConfig(**ENGINE_KW, mesh=MeshConfig(tp=2))
+    # "Host" 0: global ranks 0 (devices 0-1).  "Host" 1: rank 1 (2-3).
+    leader_engine = DPEngineGroup(cfg, dp_size=1, devices=devices[0:2],
+                                  start_rank=0)
+    worker_engine = DPEngineGroup(cfg, dp_size=1, devices=devices[2:4],
+                                  start_rank=1)
+    leader = build_server(cfg, engine=leader_engine)
+    worker = build_server(cfg, engine=worker_engine)
+    lp, wp = free_port(), free_port()
+    _start(worker, wp)
+    leader.dp_pool = DPWorkerPool([f"http://127.0.0.1:{wp}"])
+    _start(leader, lp)
+    return leader, worker, lp, wp
+
+
+def test_disjoint_rank_devices(two_hosts):
+    leader, worker, _, _ = two_hosts
+    ldev = {d for e in leader.engine.engines
+            for d in e.mesh.devices.flat}
+    wdev = {d for e in worker.engine.engines
+            for d in e.mesh.devices.flat}
+    assert ldev and wdev and not (ldev & wdev)
+    assert worker.engine.start_rank == 1
+
+
+def test_leader_serves_locally_when_idle(two_hosts):
+    leader, worker, lp, _ = two_hosts
+    r = requests.post(f"http://127.0.0.1:{lp}/v1/completions",
+                      json={"prompt": [5, 6, 7], "max_tokens": 4,
+                            "temperature": 0}, timeout=60)
+    assert r.status_code == 200
+    assert r.json()["usage"]["completion_tokens"] == 4
+
+
+def test_leader_proxies_to_worker(two_hosts):
+    """Force the dispatch decision remote: the proxied request must stream
+    back through the leader with IDENTICAL greedy output (same tiny init
+    seed on both hosts)."""
+    leader, worker, lp, _ = two_hosts
+    local = requests.post(f"http://127.0.0.1:{lp}/v1/completions",
+                          json={"prompt": [9, 8, 7], "max_tokens": 4,
+                                "temperature": 0}, timeout=60).json()
+    pool = leader.dp_pool
+    orig = pool.pick
+    pool.pick = lambda engine: pool.workers[0]
+    try:
+        remote = requests.post(f"http://127.0.0.1:{lp}/v1/completions",
+                               json={"prompt": [9, 8, 7], "max_tokens": 4,
+                                     "temperature": 0}, timeout=60).json()
+    finally:
+        pool.pick = orig
+    assert remote["choices"][0]["text"] == local["choices"][0]["text"]
+    # The worker actually served it.
+    m = requests.get(
+        f"http://127.0.0.1:{two_hosts[3]}/metrics", timeout=10).text
+    assert 'vllm:request_success_total' in m
+
+
+def test_pool_policy_least_outstanding():
+    pool = DPWorkerPool(["http://w1", "http://w2"])
+
+    class Sched:
+        num_waiting, num_running = 0, 0
+
+    class Eng:
+        scheduler = Sched()
+
+    # Idle local: serve locally.
+    assert pool.pick(Eng()) is None
+    # Loaded local, idle workers: go remote (least-inflight worker).
+    Sched.num_running = 3
+    pool.workers[0]["inflight"] = 2
+    w = pool.pick(Eng())
+    assert w is pool.workers[1]
+    # Everyone busier than local: stay local.
+    pool.workers[0]["inflight"] = 5
+    pool.workers[1]["inflight"] = 4
+    Sched.num_running = 2
+    assert pool.pick(Eng()) is None
+
+
+def test_worker_url_derivation_and_cli():
+    assert derive_dp_workers(
+        "wide-ep-decode-0.wide-ep-decode.ns", 2, 8200) == [
+        "http://wide-ep-decode-0-1.wide-ep-decode.ns:8200",
+        "http://wide-ep-decode-0-2.wide-ep-decode.ns:8200"]
+    assert derive_dp_workers("leader:1234", 1, 9000) == [
+        "http://leader-1:9000"]
+    p = build_arg_parser()
+    args = p.parse_args([
+        "--data-parallel-size", "4", "--data-parallel-size-local", "2",
+        "--data-parallel-start-rank", "2", "--data-parallel-mode", "ranks",
+        "--data-parallel-hybrid-lb",
+        "--data-parallel-address", "lead.svc", "--data-parallel-rpc-port",
+        "8200"])
+    assert args.data_parallel_size_local == 2
+    assert args.data_parallel_start_rank == 2
+    assert args.data_parallel_hybrid_lb
