@@ -238,13 +238,21 @@ def make_server(scheduler: EppScheduler, port: int,
         response_serializer=pb.ProcessingResponse.SerializeToString)
     service = grpc.method_handlers_generic_handler(
         SERVICE_NAME, {METHOD: rpc})
-    cap = (flow.max_inflight + flow.max_queue if flow is not None else 64)
-    if max_workers is None:
-        max_workers = cap
-    server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers,
-                                   thread_name_prefix="ext-proc"),
-        maximum_concurrent_rpcs=cap)
+    if flow is not None:
+        # Gate engaged: executor admits max_inflight + max_queue streams,
+        # gRPC hard-rejects beyond that (RESOURCE_EXHAUSTED).
+        cap = flow.max_inflight + flow.max_queue
+        server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers or cap,
+                thread_name_prefix="ext-proc"),
+            maximum_concurrent_rpcs=cap)
+    else:
+        # Flow control explicitly off (--max-inflight 0): keep the
+        # historical accept-everything behavior — no stream cap.
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers or 16,
+                                       thread_name_prefix="ext-proc"))
     server.add_generic_rpc_handlers((service,))
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:

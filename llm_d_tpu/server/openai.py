@@ -107,13 +107,23 @@ class DPWorkerPool:
                 timeout=aiohttp.ClientTimeout(total=None, sock_connect=5))
         worker["inflight"] += 1
         resp = None
+        # Forward end-to-end headers both ways (auth, tracing, accept —
+        # proxied and locally-served requests must be indistinguishable
+        # to clients and gateways); hop-by-hop headers stay per-hop.
+        hop = {"host", "content-length", "transfer-encoding", "connection",
+               "keep-alive", "upgrade", "te", "trailer",
+               "proxy-authorization", "proxy-authenticate"}
+        fwd_headers = {k: v for k, v in request.headers.items()
+                       if k.lower() not in hop
+                       and k.lower() != "content-type"}  # json= sets it
         try:
             async with self._session.post(
-                    worker["url"] + request.path, json=body) as upstream:
+                    worker["url"] + request.path_qs, json=body,
+                    headers=fwd_headers) as upstream:
                 resp = web.StreamResponse(
                     status=upstream.status,
-                    headers={"Content-Type": upstream.headers.get(
-                        "Content-Type", "application/json")})
+                    headers={k: v for k, v in upstream.headers.items()
+                             if k.lower() not in hop})
                 await resp.prepare(request)
                 async for chunk in upstream.content.iter_any():
                     await resp.write(chunk)
@@ -627,8 +637,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
              "role)")
     p.add_argument(
         "--kv-shared-tier-peers", default="",
-        help="comma list of peer shared-tier servers host:port consulted "
-             "on prefix miss before recompute")
+        help="comma list of peer shared-tier servers consulted on prefix "
+             "miss before recompute: static host:port entries and/or "
+             "dynamic discovery specs (dns:<svc>:<port>, "
+             "k8s:[ns/]<svc>:<port>) that follow pod churn")
     p.add_argument(
         "--quantization", default=None, choices=[None, "int8"],
         help="MoE expert-weight quantization (DeepGEMM role; halves "

@@ -7,4 +7,5 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 python -m compileall -q llm_d_tpu tests scripts bench.py __graft_entry__.py
 python scripts/lint-envvars.py
+python scripts/lint-dockerfile.py
 python -m pytest tests/

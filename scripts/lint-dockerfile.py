@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Dockerfile linter (the reference's lint-dockerfile-envvars.py role,
+/root/reference/scripts/lint-dockerfile-envvars.py + the hadolint gates
+of scripts/ENVVARS.md:100-160, expressed as in-repo checks).
+
+Checks every ``docker/Dockerfile*``:
+
+  1. ENV/ARG drift: any ``LLMD_*`` / ``LWS_*`` variable set in a
+     Dockerfile must exist in the ``docs/ENVVARS.md`` registry (a baked
+     knob the code never reads is a dead config surface), and ENV
+     defaults must not silently shadow registry defaults with different
+     values.
+  2. Structure: pinned base images (no ``:latest`` / untagged FROM),
+     a non-root ``USER``, no ``sudo``, ``apt-get install`` must pair
+     with list cleanup in the same layer, COPY over ADD for local files.
+
+Exit 1 on any finding; run by scripts/ci-gate.sh.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PREFIXES = ("LLMD_", "LWS_")
+
+DOC_RE = re.compile(r"^\|\s*`((?:%s)[A-Z0-9_]+)`\s*\|\s*`?([^|`]*)`?\s*\|"
+                    % "|".join(PREFIXES), re.M)
+
+
+def _logical_lines(text: str):
+    """Dockerfile lines with continuations folded and comments dropped."""
+    out = []
+    buf = ""
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        buf += " " + line.rstrip("\\") if buf else line.rstrip("\\")
+        if not line.endswith("\\"):
+            out.append(buf.strip())
+            buf = ""
+    if buf:
+        out.append(buf.strip())
+    return out
+
+
+def lint(path: pathlib.Path, registry: dict) -> list:
+    errs = []
+    lines = _logical_lines(path.read_text())
+    saw_user = False
+    for ln in lines:
+        word = ln.split(None, 1)[0].upper() if ln.split() else ""
+        rest = ln.split(None, 1)[1] if len(ln.split(None, 1)) > 1 else ""
+        if word == "FROM":
+            image = rest.split()[0]
+            if "@sha256:" not in image:
+                tag = image.rsplit(":", 1)[-1] if ":" in image else ""
+                if not tag or tag == "latest":
+                    errs.append(f"{path.name}: unpinned base image {image!r}"
+                                " (tag or digest required)")
+        elif word == "USER":
+            saw_user = True
+            if rest.strip() in ("root", "0", "0:0"):
+                errs.append(f"{path.name}: USER must be non-root "
+                            f"(got {rest.strip()!r})")
+        elif word in ("ENV", "ARG"):
+            for m in re.finditer(
+                    r"\b((?:%s)[A-Z0-9_]+)(?:=(\S+))?" % "|".join(PREFIXES),
+                    rest):
+                var, val = m.group(1), m.group(2)
+                if var not in registry:
+                    errs.append(
+                        f"{path.name}: {word} {var} not in docs/ENVVARS.md "
+                        "(baked knob the registry does not know)")
+                elif val is not None and registry[var] not in ("", "—") \
+                        and val != registry[var]:
+                    errs.append(
+                        f"{path.name}: {word} {var}={val} shadows the "
+                        f"registry default {registry[var]!r}")
+        elif word == "ADD" and not re.search(r"https?://", rest):
+            errs.append(f"{path.name}: use COPY instead of ADD for "
+                        f"local files ({rest.split()[0]})")
+        elif word == "RUN":
+            if re.search(r"\bsudo\b", rest):
+                errs.append(f"{path.name}: RUN uses sudo")
+            if "apt-get install" in rest \
+                    and "rm -rf /var/lib/apt/lists" not in rest:
+                errs.append(f"{path.name}: apt-get install without "
+                            "rm -rf /var/lib/apt/lists/* in the same layer")
+    if not saw_user:
+        errs.append(f"{path.name}: no USER directive (runs as root)")
+    return errs
+
+
+def main() -> int:
+    registry = {m.group(1): m.group(2).strip()
+                for m in DOC_RE.finditer(
+                    (REPO / "docs" / "ENVVARS.md").read_text())}
+    dockerfiles = sorted((REPO / "docker").glob("Dockerfile*"))
+    if not dockerfiles:
+        print("lint-dockerfile: no Dockerfiles found", file=sys.stderr)
+        return 1
+    errs = []
+    for df in dockerfiles:
+        errs.extend(lint(df, registry))
+    for e in errs:
+        print(f"lint-dockerfile: {e}", file=sys.stderr)
+    if not errs:
+        print(f"lint-dockerfile: {len(dockerfiles)} Dockerfile(s) clean")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,3 +120,42 @@ def test_envvar_lint_gate_passes():
         [sys.executable, str(repo / "scripts" / "lint-envvars.py")],
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_dockerfile_lint_gate_passes():
+    """scripts/lint-dockerfile.py (the reference's lint-dockerfile-envvars
+    role): shipped Dockerfiles are clean."""
+    import pathlib
+    import subprocess
+    import sys
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    r = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "lint-dockerfile.py")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_dockerfile_lint_catches_violations(tmp_path, monkeypatch):
+    """The linter actually rejects: unregistered env knob, latest tag,
+    root user, ADD, apt without cleanup."""
+    import importlib.util
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "lint_dockerfile", repo / "scripts" / "lint-dockerfile.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = tmp_path / "Dockerfile.bad"
+    bad.write_text(
+        "FROM python:latest\n"
+        "ENV LLMD_NOT_A_REAL_KNOB=1\n"
+        "ADD local.tar /app\n"
+        "RUN apt-get update && apt-get install -y foo\n"
+        "USER root\n")
+    errs = mod.lint(bad, {"LLMD_MOE_DISPATCH": "auto"})
+    text = "\n".join(errs)
+    assert "unpinned base image" in text
+    assert "LLMD_NOT_A_REAL_KNOB" in text
+    assert "COPY instead of ADD" in text
+    assert "apt-get install without" in text
+    assert "non-root" in text

@@ -168,6 +168,37 @@ def test_wide_ep_manifests_request_spmd_wide_ep():
         assert matched >= 1, f"no engine container found in {path}"
 
 
+def test_predicted_latency_path_complete():
+    """Reference topology (predicted-latency README.md:45-110): EPP +
+    ONE training sidecar + THREE prediction sidecars with /readyz
+    probes, both default and slo profiles, model servers posting
+    samples to the trainer."""
+    d = os.path.join(REPO, "deploy", "predicted-latency")
+    gw = open(os.path.join(d, "gateway.yaml")).read()
+    ms = open(os.path.join(d, "modelserver.yaml")).read()
+    docs = [doc for doc in yaml.safe_load_all(gw) if doc]
+    dep = next(doc for doc in docs if doc.get("kind") == "Deployment")
+    containers = dep["spec"]["template"]["spec"]["containers"]
+    names = [c["name"] for c in containers]
+    assert names[0] == "epp"
+    assert "latency-trainer" in names
+    predictors = [c for c in containers
+                  if c["name"].startswith("latency-predictor")]
+    assert len(predictors) == 3
+    for c in containers[1:]:
+        assert c["readinessProbe"]["httpGet"]["path"] == "/readyz", c["name"]
+    # Both profiles through the real parser, slo-scorer wired to the
+    # local prediction sidecars.
+    cm = next(doc for doc in docs if doc.get("kind") == "ConfigMap")
+    cfg = parse_config(cm["data"]["slo-config.yaml"])
+    assert {p.name for p in cfg.profiles} == {"default", "slo"}
+    slo_plugin = next(p for p in cfg.plugins if p.type == "slo-scorer")
+    assert "127.0.0.1:8001" in slo_plugin.parameters["predictionServerURL"]
+    # Model servers feed the trainer.
+    assert "--latency-training-url" in ms
+    assert "http://latency-trainer:8000" in ms
+
+
 def test_lws_bootstrap_env_contract():
     env = {"LWS_LEADER_ADDRESS": "wide-ep-decode-0.wide-ep-decode",
            "LWS_GROUP_SIZE": "2", "LWS_WORKER_INDEX": "1"}
