@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Run the load/error generator AGAINST an in-cluster gateway from a pod
+# on the cluster network (reference role: the monitoring scripts' load
+# generator as a deployable asset).  Shapes: uniform | prefix (shared
+# prefixes exercising the prefix scorers) | slo (prediction headers).
+set -euo pipefail
+URL="${1:?usage: generate-load.sh <gateway-url> [shape] [qps] [duration_s]}"
+SHAPE="${2:-uniform}"
+QPS="${3:-4}"
+DURATION="${4:-60}"
+IMAGE="${LLMD_IMAGE:-llm-d-tpu:latest}"
+
+kubectl run llmd-loadgen --rm -i --restart=Never --image="$IMAGE" \
+  --command -- python scripts/generate_load.py \
+  --url "$URL" --shape "$SHAPE" --qps "$QPS" --duration "$DURATION"

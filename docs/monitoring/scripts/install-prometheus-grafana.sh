@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Install kube-prometheus-stack for the llm-d-tpu monitoring surface
+# (reference role: docs/monitoring/scripts/install-prometheus-grafana.sh).
+# The PodMonitors in deploy/workload-autoscaling/wva.yaml and the
+# dashboards in docs/monitoring/grafana/ assume this stack's defaults.
+set -euo pipefail
+NS="${MONITORING_NAMESPACE:-llm-d-monitoring}"
+RELEASE="${RELEASE_NAME:-prometheus}"
+
+helm repo add prometheus-community \
+  https://prometheus-community.github.io/helm-charts
+helm repo update
+kubectl get ns "$NS" >/dev/null 2>&1 || kubectl create ns "$NS"
+helm upgrade --install "$RELEASE" \
+  prometheus-community/kube-prometheus-stack \
+  --namespace "$NS" \
+  --set grafana.sidecar.dashboards.enabled=true \
+  --set grafana.sidecar.dashboards.label=grafana_dashboard \
+  --set prometheus.prometheusSpec.podMonitorSelectorNilUsesHelmValues=false \
+  --set prometheus.prometheusSpec.serviceMonitorSelectorNilUsesHelmValues=false
+echo "Prometheus + Grafana installed in namespace $NS."
+echo "Grafana: kubectl -n $NS port-forward svc/$RELEASE-grafana 3000:80"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Load the llm-d-tpu Grafana dashboards as sidecar-discovered ConfigMaps
+# (reference role: docs/monitoring/scripts/load-llm-d-dashboards.sh).
+set -euo pipefail
+NS="${MONITORING_NAMESPACE:-llm-d-monitoring}"
+DIR="$(dirname "$0")/../grafana"
+
+for f in "$DIR"/*.json; do
+  name="$(basename "$f" .json)"
+  kubectl -n "$NS" create configmap "dash-$name" \
+    --from-file="$(basename "$f")=$f" \
+    --dry-run=client -o yaml | kubectl apply -f -
+  kubectl -n "$NS" label configmap "dash-$name" \
+    grafana_dashboard=1 --overwrite
+  echo "loaded $name"
+done

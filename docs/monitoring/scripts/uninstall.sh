@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Tear down the monitoring stack + dashboards.
+set -euo pipefail
+NS="${MONITORING_NAMESPACE:-llm-d-monitoring}"
+RELEASE="${RELEASE_NAME:-prometheus}"
+kubectl -n "$NS" delete configmap -l grafana_dashboard=1 --ignore-not-found
+helm uninstall "$RELEASE" -n "$NS" || true
+echo "monitoring stack removed from $NS (namespace left in place)"

@@ -138,11 +138,16 @@ class HostKVTier:
         self._peer_resolver = None
         self._stop = None
         if specs:
+            import asyncio
+            import threading
+
             from llm_d_tpu.epp.discovery import (
                 MultiResolver, parse_discover_spec)
-            import threading
             rs = [parse_discover_spec(s) for s in specs]
             self._peer_resolver = rs[0] if len(rs) == 1 else MultiResolver(rs)
+            # One loop for the tier's lifetime (see _refresh_peers): used
+            # synchronously here once, then only by the refresh thread.
+            self._resolver_loop = asyncio.new_event_loop()
             self._refresh_peers()          # synchronous first resolve
             self._stop = threading.Event()
             self._refresh_thread = threading.Thread(
@@ -154,11 +159,14 @@ class HostKVTier:
         km.secondary_lookup = self._restore
 
     def _refresh_peers(self) -> None:
-        import asyncio
         try:
-            # The EPP resolvers are async (they run on its event loop);
-            # this refresh thread has no loop, so drive one per tick.
-            resolved = asyncio.run(self._peer_resolver.resolve())
+            # The EPP resolvers are async and may cache clients bound to
+            # their loop (K8sEndpointSliceResolver keeps one aiohttp
+            # session), so the tier owns ONE loop for its whole lifetime —
+            # a fresh asyncio.run() per tick would strand those clients on
+            # a closed loop and freeze the peer view after the first tick.
+            resolved = self._resolver_loop.run_until_complete(
+                self._peer_resolver.resolve())
         except Exception as exc:
             logger.warning("shared-tier peer resolve failed: %s", exc)
             return
@@ -187,6 +195,15 @@ class HostKVTier:
     def close(self) -> None:
         if self._stop is not None:
             self._stop.set()
+            self._refresh_thread.join(timeout=2 * self.peer_refresh_s)
+            closer = getattr(self._peer_resolver, "close", None)
+            try:
+                if closer is not None and not self._resolver_loop.is_running():
+                    self._resolver_loop.run_until_complete(closer())
+            except Exception:                   # best-effort cleanup
+                pass
+            if not self._resolver_loop.is_running():
+                self._resolver_loop.close()
         if self.server is not None:
             self.server.close()
 

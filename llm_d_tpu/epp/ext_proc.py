@@ -248,11 +248,15 @@ def make_server(scheduler: EppScheduler, port: int,
                 thread_name_prefix="ext-proc"),
             maximum_concurrent_rpcs=cap)
     else:
-        # Flow control explicitly off (--max-inflight 0): keep the
-        # historical accept-everything behavior — no stream cap.
+        # Flow control explicitly off (--max-inflight 0): a plain bounded
+        # server — workers and stream cap MATCH, so excess streams get a
+        # fast RESOURCE_EXHAUSTED instead of being accepted and parked
+        # unserviced in the executor queue.
+        n = max_workers or 64
         server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers or 16,
-                                       thread_name_prefix="ext-proc"))
+            futures.ThreadPoolExecutor(max_workers=n,
+                                       thread_name_prefix="ext-proc"),
+            maximum_concurrent_rpcs=n)
     server.add_generic_rpc_handlers((service,))
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:

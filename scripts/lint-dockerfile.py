@@ -51,16 +51,23 @@ def lint(path: pathlib.Path, registry: dict) -> list:
     errs = []
     lines = _logical_lines(path.read_text())
     saw_user = False
+    stage_names: set = set()      # FROM <image> AS <stage> re-references
     for ln in lines:
         word = ln.split(None, 1)[0].upper() if ln.split() else ""
         rest = ln.split(None, 1)[1] if len(ln.split(None, 1)) > 1 else ""
         if word == "FROM":
             image = rest.split()[0]
-            if "@sha256:" not in image:
-                tag = image.rsplit(":", 1)[-1] if ":" in image else ""
+            if image.lower() != "scratch" and "@sha256:" not in image \
+                    and image not in stage_names:
+                # The tag lives after the last '/': a registry port
+                # ("registry:5000/base") must not read as a tag.
+                last = image.rsplit("/", 1)[-1]
+                tag = last.rsplit(":", 1)[-1] if ":" in last else ""
                 if not tag or tag == "latest":
                     errs.append(f"{path.name}: unpinned base image {image!r}"
                                 " (tag or digest required)")
+            if " as " in f" {rest.lower()} ":
+                stage_names.add(rest.split()[-1])
         elif word == "USER":
             saw_user = True
             if rest.strip() in ("root", "0", "0:0"):

@@ -159,3 +159,18 @@ def test_dockerfile_lint_catches_violations(tmp_path, monkeypatch):
     assert "COPY instead of ADD" in text
     assert "apt-get install without" in text
     assert "non-root" in text
+
+
+def test_v5p256_projection_model():
+    """North-star paper model (round-4 verdict #7): documented arithmetic,
+    sane bounds, efficiency factor taken from measured rooflines."""
+    import bench
+    r = bench.project_v5p256(0.5)
+    a = r["assumptions"]
+    assert 100 < r["projected_v5p256_tok_s_chip"] < 50000
+    # DSv3 experts: ~673 GB int8 over 256 chips.
+    assert 2.0 < a["expert_gb_per_chip"] < 3.5
+    assert a["bound"] in ("ici", "hbm+mxu")
+    # Efficiency scales output linearly.
+    half = bench.project_v5p256(0.25)["projected_v5p256_tok_s_chip"]
+    assert abs(half * 2 - r["projected_v5p256_tok_s_chip"]) < 1.0
