@@ -8,4 +8,5 @@ cd "$(dirname "$0")/.."
 python -m compileall -q llm_d_tpu tests scripts bench.py __graft_entry__.py
 python scripts/lint-envvars.py
 python scripts/lint-dockerfile.py
+for f in scripts/*.sh docs/monitoring/scripts/*.sh; do bash -n "$f"; done
 python -m pytest tests/
