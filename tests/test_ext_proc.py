@@ -152,3 +152,52 @@ def test_ext_proc_wire_parity_with_http_plane(stack):
         {"prompt": "hello", "max_tokens": 2})))
     after = sched.metrics.render().decode()
     assert before != after   # scheduler histogram observed the gRPC request
+
+
+def test_sync_flow_control_gate():
+    """Thread-safe admission for the ext_proc plane (advisor r4 medium):
+    slots bound concurrency, sheddables never queue, the queue bounds and
+    times out, release wakes waiters."""
+    import threading
+    import time as _time
+
+    from llm_d_tpu.epp.ext_proc import SyncFlowControl
+
+    fc = SyncFlowControl(max_inflight=2, max_queue=1, queue_timeout_s=0.2)
+    assert fc.acquire(sheddable=False) == "ok"
+    assert fc.acquire(sheddable=False) == "ok"
+    # Saturated: sheddable sheds immediately, non-sheddable queues.
+    assert fc.acquire(sheddable=True) == "saturated"
+
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(fc.acquire(sheddable=False)))
+    t.start()
+    _time.sleep(0.05)
+    # Queue now holds one waiter: the next non-sheddable is rejected.
+    assert fc.acquire(sheddable=False) == "queue_full"
+    fc.release()                      # wakes the queued waiter
+    t.join(2)
+    assert results == ["ok"]
+    # Timeout path: both slots still held (1 original + the waiter's).
+    assert fc.acquire(sheddable=False) == "timeout"
+    fc.release()
+    fc.release()
+    assert fc.acquire(sheddable=False) == "ok"
+
+
+def test_ext_proc_handler_enforces_flow_control():
+    """A saturated handler answers 429 before scheduling; release
+    restores normal routing."""
+    from llm_d_tpu.epp.ext_proc import ExtProcHandler, SyncFlowControl
+
+    sched = _scheduler([EndpointState(address="10.0.0.1:8200", ready=True)])
+    fc = SyncFlowControl(max_inflight=1, max_queue=0, queue_timeout_s=0.1)
+    handler = ExtProcHandler(sched, flow=fc)
+    assert fc.acquire(sheddable=False) == "ok"   # hold the only slot
+    resp = handler._schedule({}, b'{"model": "m", "prompt": "x"}')
+    assert resp.immediate_response.status.code == 429
+    fc.release()
+    resp = handler._schedule({}, b'{"model": "m", "prompt": "x"}')
+    assert resp.HasField("request_body")
+    assert fc._inflight == 0         # schedule released its slot
