@@ -97,12 +97,31 @@ def _mla_decode_kernel(
     for g in range(G):
         sl_arr = jnp.where(g_ids == g, seq_len_g[g], sl_arr)
 
+    def wb_copy(g):
+        """The (re-constructible) write-back descriptor for group g."""
+        wp = write_page_g[g]
+        b = block_tables_ref[base + g, jnp.maximum(wp, 0)]
+        start = pl.multiple_of(b * bs, bs)
+        return pltpu.make_async_copy(
+            kv_buf.at[wp % 2, g], kv_out.at[li, pl.ds(start, bs)],
+            wsems.at[g])
+
     def body(j, carry):
         m, l, acc = carry
         slot = j % 2
 
         @pl.when(j + 1 < n_max)
         def _():
+            # Before an inbound page DMA reuses (slot, g), consume any
+            # still-flying write-back FROM that buffer (started at
+            # j == wp_g, reused for page wp_g + 2).  Pad rows (seq_len 0
+            # -> wp_g = -1) never STARTED a write: waiting their
+            # never-signaled semaphore would deadlock the kernel.
+            for g in range(G):
+                @pl.when((write_page_g[g] >= 0)
+                         & (j == write_page_g[g] + 1))
+                def _(g=g):
+                    wb_copy(g).wait()
             for dma in page_dma((j + 1) % 2, j + 1):
                 dma.start()
 
@@ -110,19 +129,17 @@ def _mla_decode_kernel(
             dma.wait()
 
         # On each sequence's write page (exactly once per call): splice the
-        # new latent row into the resident page and write the page back.
+        # new latent row into the resident page and START the page
+        # write-back — the wait happens at slot reuse (above) or after the
+        # loop, so the write flies UNDER the score/value dots instead of
+        # stalling every group serially (decode writes land on the LAST
+        # page, so in the common case all waits coalesce after the loop).
         for g in range(G):
             @pl.when(j == write_page_g[g])
             def _(g=g):
                 is_wr = row_ids2 == w_row_g[g]
                 kv_buf[slot, g] = jnp.where(is_wr, rn_ref[g], kv_buf[slot, g])
-                b = block_tables_ref[base + g, j]
-                start = pl.multiple_of(b * bs, bs)
-                wc = pltpu.make_async_copy(
-                    kv_buf.at[slot, g], kv_out.at[li, pl.ds(start, bs)],
-                    wsems.at[g])
-                wc.start()
-                wc.wait()
+                wb_copy(g).start()
 
         # bf16 operands, f32 accumulation: 2x MXU rate, no VPU convert of
         # the page (see paged_attention.py's decode kernel).
@@ -147,6 +164,14 @@ def _mla_decode_kernel(
             jnp.zeros((G, H, 1), jnp.float32),
             jnp.zeros((G, H, F), jnp.float32))
     m, l, acc = jax.lax.fori_loop(0, n_max, body, init)
+    # Consume write-backs whose slot was never reused in-loop (every
+    # started DMA must be waited before the kernel ends): started at
+    # wp_g >= 0, in-loop wait only ran when wp_g + 2 < n_max.
+    for g in range(G):
+        @pl.when((write_page_g[g] >= 0)
+                 & (write_page_g[g] + 2 >= n_max))
+        def _(g=g):
+            wb_copy(g).wait()
     o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 

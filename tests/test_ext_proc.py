@@ -173,7 +173,10 @@ def test_sync_flow_control_gate():
     t = threading.Thread(
         target=lambda: results.append(fc.acquire(sheddable=False)))
     t.start()
-    _time.sleep(0.05)
+    deadline = _time.monotonic() + 5.0
+    while fc._queued != 1 and _time.monotonic() < deadline:
+        _time.sleep(0.005)          # wait until the waiter is enqueued
+    assert fc._queued == 1
     # Queue now holds one waiter: the next non-sheddable is rejected.
     assert fc.acquire(sheddable=False) == "queue_full"
     fc.release()                      # wakes the queued waiter
