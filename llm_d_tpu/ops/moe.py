@@ -124,14 +124,10 @@ def _local_expert_ffn(
     lid = flat - e0
     is_local = (lid >= 0) & (lid < E_loc)
     sort_key = jnp.where(is_local, lid, E_loc)
-    order = jnp.argsort(sort_key, stable=True)                  # [S]
+    order, inv, key_counts = _stable_argsort_bounded(sort_key, E_loc + 1)
     tok = order // k
     xs = x[tok]                                                 # [S, H]
-
-    counts = jnp.zeros(E_loc, jnp.int32).at[
-        jnp.clip(lid, 0, E_loc - 1)].add(is_local.astype(jnp.int32))
-    trash = S - counts.sum()
-    group_sizes = jnp.concatenate([counts, trash[None]])        # [E_loc+1]
+    group_sizes = key_counts                # [E_loc+1], last = trash group
 
     zpad = jnp.zeros((1,) + w_gate.shape[1:], w_gate.dtype)
     y = _swiglu_grouped(
@@ -144,11 +140,12 @@ def _local_expert_ffn(
 
     wslot = (weights.reshape(S)[order]
              * is_local[order].astype(jnp.float32))[:, None]
-    return _unsort_combine(y * wslot, order, T, k)
+    return _unsort_combine(y * wslot, order, T, k, inv=inv)
 
 
 def _unsort_combine(y: jax.Array, order: jax.Array, T: int, k: int,
-                    dest: Optional[jax.Array] = None) -> jax.Array:
+                    dest: Optional[jax.Array] = None,
+                    inv: Optional[jax.Array] = None) -> jax.Array:
     """Per-token combine WITHOUT a [T, H] scatter-add (XLA lowers big row
     scatters to serialized updates on TPU): un-sort via the inverse
     permutation (a cheap 1-D scatter + ONE fast row gather), then a
@@ -157,8 +154,9 @@ def _unsort_combine(y: jax.Array, order: jax.Array, T: int, k: int,
     layout where sorted slot ``s`` lives at row ``dest[s]`` (the grouped
     kernel's layout); the index composition stays int32-only."""
     S = T * k
-    inv = jnp.zeros((S,), jnp.int32).at[order].set(
-        jnp.arange(S, dtype=jnp.int32))
+    if inv is None:
+        inv = jnp.zeros((S,), jnp.int32).at[order].set(
+            jnp.arange(S, dtype=jnp.int32))
     src = inv if dest is None else dest[inv]
     # f32 AFTER the gather (bf16 rows move at half the bytes); the k-sum
     # accumulates in f32 either way.
@@ -237,10 +235,9 @@ def _grouped_int8_kernel_path(x, weights, idx, quant: dict,
     # S itself must round to a tile multiple (T*k need not be one).
     S_pad = -(-S // rt) * rt + E * rt
     flat = idx.reshape(S)
-    order = jnp.argsort(flat, stable=True)
+    order, sort_inv, counts = _stable_argsort_bounded(flat, E)
     eid_s = flat[order]
     tok_s = order // k
-    counts = jnp.zeros(E, jnp.int32).at[flat].add(1)
     padded = -(-counts // rt) * rt
     offs = _excl_cumsum(padded)
     rank = jnp.arange(S, dtype=jnp.int32) - _excl_cumsum(counts)[eid_s]
@@ -267,7 +264,8 @@ def _grouped_int8_kernel_path(x, weights, idx, quant: dict,
         quant["w_up_q"], quant["w_up_s"],
         quant["w_down_q"], quant["w_down_s"],
         row_tile=rt, interpret=interpret)
-    return _unsort_combine(y_pad, order, T, k, dest=dest).astype(x.dtype)
+    return _unsort_combine(y_pad, order, T, k, dest=dest,
+                           inv=sort_inv).astype(x.dtype)
 
 
 def _dense_int8_kernel_path(x, weights, idx, quant: dict,
@@ -325,6 +323,33 @@ def _excl_cumsum(v: jax.Array) -> jax.Array:
     return jnp.concatenate([jnp.zeros(1, v.dtype), jnp.cumsum(v)[:-1]])
 
 
+def _stable_argsort_bounded(
+        keys: jax.Array, bound: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Stable argsort for integer keys in [0, bound) — counting sort from
+    cheap primitives.
+
+    ``jnp.argsort`` on TPU is a bitonic network: measured 4.3 ms for
+    65536 int32 on v5e — at one sort per MoE layer that was ~65 ms of a
+    ~440 ms prefill step.  This build (one-hot cumsum for stable ranks +
+    a 1-D scatter) moves ~2*S*bound i32 bytes instead: ~0.4 ms at the
+    same shape, identical output order.
+
+    Returns (order, dest, counts): ``order`` is the argsort result,
+    ``dest`` its inverse permutation (``dest[s]`` = where element s
+    landed — callers need it anyway and rebuilding it is another
+    scatter), ``counts`` the per-key histogram."""
+    S = keys.shape[0]
+    one_hot = (keys[:, None] == jnp.arange(bound, dtype=keys.dtype)[None, :])
+    cum = jnp.cumsum(one_hot.astype(jnp.int32), axis=0)
+    rank = cum[jnp.arange(S), keys] - 1                # stable within-key rank
+    counts = cum[-1]                                   # totals: free from cum
+    dest = _excl_cumsum(counts)[keys] + rank           # position in sorted order
+    order = jnp.zeros((S,), jnp.int32).at[dest].set(
+        jnp.arange(S, dtype=jnp.int32))
+    return order, dest, counts
+
+
 def _a2a_moe_chunk(
     x_c: jax.Array,        # [Tc, H] this shard's token chunk
     w_c: jax.Array,        # [Tc, k]
@@ -351,12 +376,12 @@ def _a2a_moe_chunk(
 
     flat = idx_c.reshape(S)
     dest = (flat // E_loc).astype(jnp.int32)
-    order = jnp.argsort(dest, stable=True)          # send order: by dest shard
+    order, _, send_counts = _stable_argsort_bounded(
+        dest, ep)                                   # send order: by dest shard
     dest_s = dest[order]
     eloc_s = (flat % E_loc)[order].astype(jnp.int32)
     tok_s = order // k
 
-    send_counts = jnp.zeros(ep, jnp.int32).at[dest].add(1)
     input_offsets = _excl_cumsum(send_counts)
     all_counts = jax.lax.all_gather(
         send_counts, AXIS_EP, tiled=False)          # [ep_src, ep_dst]
@@ -388,7 +413,7 @@ def _a2a_moe_chunk(
     region = jnp.arange(rows, dtype=jnp.int32) // S
     valid = (jnp.arange(rows, dtype=jnp.int32) % S) < recv_sizes[region]
     e_key = jnp.where(valid, recv_e, E_loc)
-    order2 = jnp.argsort(e_key, stable=True)
+    order2, _, _ = _stable_argsort_bounded(e_key, E_loc + 1)
     xs = recv_x[order2]
     counts_e = jnp.zeros(E_loc, jnp.int32).at[
         jnp.where(valid, recv_e, 0)].add(valid.astype(jnp.int32))
