@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import time
 
 import jax
@@ -129,9 +130,15 @@ def _make_reqs(tag, n, prompt_len, decode_steps, offset):
 
 
 def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
-                quantization=None):
+                quantization=None, repeats=None, stub=()):
     """One engine, a workload per batch size (warmup + timed).  Returns
-    {bs: {prefill_tok_s, decode_tok_s, ...}} plus roofline attribution."""
+    {bs: {prefill_tok_s, decode_tok_s, ...}} plus roofline attribution.
+
+    ``repeats`` maps batch size -> N timed runs (default 1): gated
+    headline numbers use median-of-N with a printed min/max band so the
+    regression gate can tell a real drop from the chip's measured ±4-6%
+    run-to-run variance (VERDICT r5 #4).  ``stub`` drops components from
+    the compiled program for the attribution harness (--stub)."""
     max_bs = max(batch_sizes)
     # KV sized to the workload + slack: the tunnel chip's usable HBM is
     # well under the nominal 16 GB, so a fixed large pool OOMs the MoE run.
@@ -151,6 +158,7 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
         # removes any chance the warmup pass warms more than the compiles.
         enable_prefix_caching=False,
         quantization=quantization,
+        stub_components=tuple(stub),
     )
     engine = EngineCore(cfg)
     c = engine.model_config
@@ -172,12 +180,21 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
         offset = 1000 * bs
         _run_workload(engine, _make_reqs(
             f"warm{bs}", bs, prompt_len, decode_steps, 50000 + offset))
-        t_prefill, t_decode, decode_tokens = _run_workload(
-            engine, _make_reqs(f"bench{bs}", bs, prompt_len, decode_steps,
-                               offset))
+        n_rep = (repeats or {}).get(bs, 1)
+        prefill_runs, decode_runs = [], []
+        for rep in range(n_rep):
+            # Disjoint token ids per repeat: identical-argument jitted
+            # calls can be served from a remote cache (perf-notes-r5).
+            t_prefill, t_decode, decode_tokens = _run_workload(
+                engine, _make_reqs(f"bench{bs}r{rep}", bs, prompt_len,
+                                   decode_steps, offset + 97 * rep))
+            prefill_runs.append(bs * prompt_len / t_prefill)
+            decode_runs.append(decode_tokens / t_decode)
         prompt_tokens = bs * prompt_len
-        prefill_tok_s = prompt_tokens / t_prefill
-        decode_tok_s = decode_tokens / t_decode
+        prefill_tok_s = statistics.median(prefill_runs)
+        decode_tok_s = statistics.median(decode_runs)
+        t_prefill = prompt_tokens / prefill_tok_s
+        t_decode = bs * decode_steps / decode_tok_s
 
         body_flops = 2 * active
         prefill_mfu = (body_flops * prompt_tokens + head_flops * bs) \
@@ -196,6 +213,13 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
                 100 * decode_tok_s / roofline_tok_s, 1),
             "decode_ms_per_step": round(1000 * t_decode / decode_steps, 2),
         }
+        if n_rep > 1:
+            out[bs]["decode_tok_s_runs"] = [round(v, 1) for v in decode_runs]
+            out[bs]["decode_tok_s_band"] = [round(min(decode_runs), 1),
+                                            round(max(decode_runs), 1)]
+            out[bs]["decode_band_spread_pct"] = round(
+                100 * (max(decode_runs) - min(decode_runs))
+                / max(decode_tok_s, 1e-9), 1)
     out["param_bytes"] = param_bytes
     return out
 
@@ -291,17 +315,101 @@ def project_v5p256(measured_roofline_frac: float,
     }
 
 
+def v5p256_sensitivity(measured_roofline_frac: float) -> dict:
+    """VERDICT r5 #6: sweep the projection over context x bs/chip instead
+    of quoting the single friendliest point.  Reports the margin vs the
+    2,200 tok/s/chip bar per point and the first point (sweep order:
+    context ascending, then bs descending) where the bar fails — the
+    honest statement of how far the thin 4.8% margin actually extends.
+    The measured single-chip efficiency factor is held constant across
+    the sweep (its context term is modeled, not re-measured)."""
+    bar = BASELINE_TOK_S_PER_CHIP
+    points = {}
+    first_fail = None
+    for ctx in (2048, 8192, 32768):
+        for bs in (256, 128):
+            p = project_v5p256(measured_roofline_frac,
+                               decode_bs_per_chip=bs, context_len=ctx)
+            tok_s = p["projected_v5p256_tok_s_chip"]
+            key = f"ctx{ctx}_bs{bs}"
+            points[key] = {
+                "tok_s_chip": tok_s,
+                "margin_vs_2200_pct": round(100 * (tok_s / bar - 1), 1),
+                "bound": p["assumptions"]["bound"],
+            }
+            if first_fail is None and tok_s < bar:
+                first_fail = key
+    return {"points": points, "first_failing_point": first_fail,
+            "bar_tok_s_chip": bar}
+
+
+def _regression_gate(dense: dict, moe: dict) -> dict:
+    """Band-aware regression gate over the two headline metrics.
+
+    ``*_delta_pct`` is the MEDIAN's delta vs the best recorded number;
+    ``*_regressed`` is True only when the run band's MAX is below it —
+    i.e. not even the luckiest of N runs reached the old number, which a
+    ±4-6% noise band cannot explain.  Gate on ``*_regressed``, read
+    ``*_delta_pct`` for trend."""
+    gate = {}
+    for name, sweep, bs, best in (
+            ("dense_bs64", dense, 64, 11196.7),    # BENCH_r03
+            ("moe_bs256", moe, 256, 16060.6)):     # r5 final (wb pipelining)
+        gate[f"{name}_best_recorded"] = best
+        if bs not in sweep:
+            gate[f"{name}_delta_pct"] = None
+            continue
+        row = sweep[bs]
+        med = row["decode_tok_s"]
+        gate[f"{name}_delta_pct"] = round(100 * (med / best - 1), 1)
+        band = row.get("decode_tok_s_band")
+        if band is None:
+            # Single sample (--quick / --gate-repeats 1): a point inside
+            # the ±4-6% noise band must not be called a regression — no
+            # verdict without a band.
+            gate[f"{name}_regressed"] = None
+        else:
+            gate[f"{name}_band"] = band
+            gate[f"{name}_regressed"] = bool(band[1] < best)
+    return gate
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="one batch size per model (dev loop)")
+    ap.add_argument("--gate-repeats", type=int, default=5,
+                    help="median-of-N runs for the two gated headline "
+                         "numbers (>=5 for the band to mean anything)")
+    ap.add_argument("--stub", choices=["attn", "moe_ffn", "shared_expert"],
+                    help="attribution mode: run ONLY the MoE model with "
+                         "this component stubbed out of the compiled "
+                         "program (fresh process per stub; compare "
+                         "ms/step against an unstubbed run) — covers "
+                         "prefill AND decode")
     args = ap.parse_args()
+
+    if args.stub:
+        sizes = [64, 256]
+        moe = bench_model("deepseek-v3-bench", sizes, quantization="int8",
+                          stub=(args.stub,))
+        print(json.dumps({
+            "metric": "attribution_stub",
+            "stub": args.stub,
+            "unit": "ms/step",
+            "extras": {"moe_sweep": {str(b): moe[b] for b in sizes}},
+        }))
+        return
 
     moe_sizes = [256] if args.quick else [64, 256, 512]
     dense_sizes = [64] if args.quick else [64, 128, 256]
+    # --quick is the dev loop: single runs, no band (the gate still
+    # prints medians-of-1; only full runs are quotable).
+    n = 1 if args.quick else max(1, args.gate_repeats)
 
-    moe = bench_model("deepseek-v3-bench", moe_sizes, quantization="int8")
-    dense = bench_model("llama3-1b", dense_sizes)
+    moe = bench_model("deepseek-v3-bench", moe_sizes, quantization="int8",
+                      repeats={256: n})
+    dense = bench_model("llama3-1b", dense_sizes, repeats={64: n})
 
     best_bs = max(moe_sizes, key=lambda b: moe[b]["decode_tok_s"])
     headline = moe[best_bs]["decode_tok_s"]
@@ -329,21 +437,17 @@ def main() -> None:
             moe[256]["decode_hbm_roofline_pct"] / 100.0
             if 256 in moe else
             moe[best_bs]["decode_hbm_roofline_pct"] / 100.0),
-        # Regression gate (round-4 verdict #4): best previously recorded
-        # numbers per metric — a silent drop in EITHER the dense or the
-        # MoE path shows up as a negative delta here, every round.  The
-        # shared tunneled chip shows ~±4% run-to-run variance; deltas
-        # beyond that are real.
-        "regression_gate": {
-            "dense_bs64_best_recorded": 11196.7,   # BENCH_r03
-            "dense_bs64_delta_pct": round(
-                100 * (dense[64]["decode_tok_s"] / 11196.7 - 1), 1)
-            if 64 in dense else None,
-            "moe_bs256_best_recorded": 16060.6,    # r5 final (wb pipelining)
-            "moe_bs256_delta_pct": round(
-                100 * (moe[256]["decode_tok_s"] / 16060.6 - 1), 1)
-            if 256 in moe else None,
-        },
+        # Projection sensitivity (VERDICT r5 #6): the 2.2k bar must be
+        # checked off the friendliest point too.
+        "v5p256_sensitivity": v5p256_sensitivity(
+            moe[256]["decode_hbm_roofline_pct"] / 100.0
+            if 256 in moe else
+            moe[best_bs]["decode_hbm_roofline_pct"] / 100.0),
+        # Regression gate (VERDICT r5 #4): median-of-N with a min/max
+        # band.  A metric REGRESSES only when its whole band sits below
+        # the best recorded number — a point sample inside the chip's
+        # measured ±4-6% variance is noise, not a regression.
+        "regression_gate": _regression_gate(dense, moe),
     }
     result = {
         "metric": "decode_output_tok_s_per_chip_moe",

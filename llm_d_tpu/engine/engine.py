@@ -95,6 +95,12 @@ class EngineConfig:
     kv_shared_tier_peers: Tuple[str, ...] = ()  # "host:port" peer servers
     # MoE expert-weight quantization (DeepGEMM role; "int8" or None).
     quantization: Optional[str] = None
+    # Perf-attribution harness (docs/perf-notes methodology): components
+    # to STUB OUT of the step program so their cost can be measured by
+    # difference, in a fresh process, on BOTH phases (prefill + decode —
+    # the r5 harness covered decode only).  Values: "attn", "moe_ffn",
+    # "shared_expert".  Changes model output — bench/diagnostics only.
+    stub_components: Tuple[str, ...] = ()
 
     def resolve_model(self) -> ModelConfig:
         return self.model_config or get_config(self.model)
@@ -271,10 +277,14 @@ class EngineCore:
         if not self.model_config.is_moe:
             return None
         if not self.config.enable_dbo:
-            return dict(dbo_decode_min_tokens=-1, dbo_prefill_min_tokens=-1)
-        return dict(
-            dbo_decode_min_tokens=self.config.dbo_decode_token_threshold,
-            dbo_prefill_min_tokens=self.config.dbo_prefill_token_threshold)
+            opts = dict(dbo_decode_min_tokens=-1, dbo_prefill_min_tokens=-1)
+        else:
+            opts = dict(
+                dbo_decode_min_tokens=self.config.dbo_decode_token_threshold,
+                dbo_prefill_min_tokens=self.config.dbo_prefill_token_threshold)
+        if self.config.stub_components:
+            opts["stub_components"] = tuple(self.config.stub_components)
+        return opts
 
     def _build_step_fn(self, want_top_logprobs: bool = False):
         c = self.model_config

@@ -132,6 +132,10 @@ def forward(
     is_decode = batch["qtok_idx"].shape[-1] == 1
     dbo_min_tokens = (moe_opts or {}).get(
         "dbo_decode_min_tokens" if is_decode else "dbo_prefill_min_tokens")
+    # Attribution stubs (EngineConfig.stub_components): drop a component
+    # from the compiled program so its cost is measurable by difference
+    # on either phase.  Shapes and the rest of the program are unchanged.
+    stub = frozenset((moe_opts or {}).get("stub_components") or ())
 
     def attend_local(lp, hn, caches, ab, li):
         """Attention dispatch: MLA (single latent buffer) or classic GQA."""
@@ -149,6 +153,8 @@ def forward(
     def attend(lp, hn, caches, li):
         """Stacked mode: per-dp-shard attention (manual dp, auto tp) —
         the dp half of the wide-EP regime; see parallel.dp_attention."""
+        if "attn" in stub:
+            return jnp.zeros_like(hn), caches
         if stacked:
             from llm_d_tpu.parallel.dp_attention import dp_attend
             return dp_attend(attend_local, mesh, lp, hn, caches, batch, li)
@@ -200,12 +206,15 @@ def forward(
         else:
             quant = None
             w_gate, w_up, w_down = lp["w_gate"], lp["w_up"], lp["w_down"]
-        m = moe_ops.expert_ffn(
-            ht, weights, phys_idx, w_gate, w_up, w_down, mesh=mesh,
-            dbo_min_tokens=dbo_min_tokens, quant=quant)
+        if "moe_ffn" in stub:
+            m = jnp.zeros_like(ht)   # routing still runs (EPLB collect)
+        else:
+            m = moe_ops.expert_ffn(
+                ht, weights, phys_idx, w_gate, w_up, w_down, mesh=mesh,
+                dbo_min_tokens=dbo_min_tokens, quant=quant)
         if stacked:
             m = m.reshape(hn.shape)
-        if "shared_gate" in lp:
+        if "shared_gate" in lp and "shared_expert" not in stub:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])
         return (h + m, caches, li + 1), idx

@@ -8,7 +8,9 @@ wide-ep decode.yaml:76-132).  Design:
     sorts — computed replicated on every device; only expert FFNs shard.
   - Grouped GEMM: tokens are sorted by expert id and fed to
     ``jax.lax.ragged_dot`` — one MXU-friendly kernel over all local experts
-    instead of a Python loop (the DeepGEMM role).
+    instead of a Python loop (the DeepGEMM role).  The int8 path has its
+    own three-kernel family (dense streaming / fused-routing routed /
+    sorted grouped — see ``DENSE_INT8_MAX_T`` and ``ops.pallas``).
   - Expert parallelism: experts shard over the *flattened* (dp, sp, tp) mesh
     axes ("TPxDP in attention, EP in MoE layers", decode.yaml:76,87).  Two
     dispatch strategies:
@@ -41,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from llm_d_tpu.models.config import ModelConfig
 from llm_d_tpu.parallel.mesh import AXIS_EP
+from llm_d_tpu.utils.jax_compat import shard_map
 
 
 def route(
@@ -200,13 +203,113 @@ def _dense_expert_ffn(
 # single shard (measured crossover on v5e; see _dense_expert_ffn).
 DENSE_DISPATCH_MAX_T = 512
 
-# int8 kernel routing: at or below this T the dense streaming kernel wins;
-# above it the grouped kernel computes S = T*k rows instead of T*E — E/k
-# times less MXU work once the op turns compute-bound (prefill regime).
-# Measured on v5e at deepseek-v3-bench shapes: decode bs256 (T=256) runs
-# 15.2k tok/s dense vs 14.5k grouped (sort/pad glue + small tiles eat the
-# FLOP win), while prefill chunks (T=8192) run 2.2x faster grouped.
-GROUPED_INT8_MIN_T = 256
+# int8 kernel routing, three regimes (r6 retune — see
+# ops/pallas/moe_routed.py and docs/perf-notes-r6.md):
+#
+#   T <= DENSE_INT8_MAX_T           dense all-experts streaming kernel.
+#     Weight-bound tiny batches: all-experts compute rides under the
+#     weight-stream time anyway, and the routed kernel's per-tile
+#     padding (up to E*rt/2 phantom rows) is at its relative worst.
+#   DENSE < T <= GROUPED_INT8_MIN_T fused-routing routed kernel.
+#     The decode sweet spot: x stays VMEM-resident, gather/combine run
+#     as one-hot matmuls inside the kernel, compute is T*k rows.
+#   T >  GROUPED_INT8_MIN_T         sorted+padded grouped kernel.
+#     Prefill: x no longer fits VMEM whole (T=8192 is 32 MB bf16), the
+#     XLA sort/pad glue amortizes over big tiles (measured 2.2x over
+#     dense at T=8192, r5).
+#
+# r5 measured the OLD two-way crossover at 256 because the grouped
+# kernel's XLA row glue ate the FLOP win at decode sizes; the routed
+# kernel removes that glue, so the dense window shrinks to the
+# genuinely weight-bound region and the grouped takeover moves to the
+# VMEM-residency bound.  Re-measure on chip via
+# LLMD_MOE_DENSE_KERNEL_MAX_T / LLMD_MOE_GROUPED_MIN_T.
+DENSE_INT8_MAX_T = 64
+GROUPED_INT8_MIN_T = 512
+
+
+def _sorted_tile_layout(flat: jax.Array, weights_flat: jax.Array,
+                        k: int, E: int, rt: int):
+    """Counting-sort tile layout shared by the routed and grouped int8
+    kernel paths: rows sorted by expert, each group padded to a ``rt``
+    multiple, one expert per tile.
+
+    Returns ``(order, inv, tok_s, slot, wslot_pad, tile_expert,
+    num_tiles)``: ``slot[s]`` is sorted element s's position in the
+    padded layout (static worst case ``S_pad = ceil(S/rt)*rt + E*rt`` —
+    METADATA length only, no [_, H] rows); ``wslot_pad`` carries the
+    combine weight per padded slot (0 = pad); ``tile_expert`` maps each
+    of the ``S_pad // rt`` static tiles to its expert, with inactive
+    trailing tiles REPEATING the last active tile's expert so their
+    weight-block index map repeats and Pallas skips the DMA (clamping to
+    E-1 instead would stream one unused expert whenever E-1 is empty);
+    ``num_tiles`` counts the populated tiles.  Empty experts get zero
+    tiles — their weights are never streamed."""
+    S = flat.shape[0]
+    order, inv, counts = _stable_argsort_bounded(flat, E)
+    eid_s = flat[order]
+    tok_s = (order // k).astype(jnp.int32)
+    padded = -(-counts // rt) * rt
+    offs = _excl_cumsum(padded)
+    rank = jnp.arange(S, dtype=jnp.int32) - _excl_cumsum(counts)[eid_s]
+    slot = offs[eid_s] + rank
+    S_pad = -(-S // rt) * rt + E * rt
+    NT = S_pad // rt
+    wslot_pad = jnp.zeros((S_pad,), jnp.float32).at[slot].set(
+        weights_flat[order])
+    num_tiles = padded.sum() // rt                 # >= 1: S >= 1 always
+    bounds = jnp.cumsum(padded)
+    starts = jnp.minimum(jnp.arange(NT, dtype=jnp.int32),
+                         num_tiles - 1) * rt
+    tile_expert = jnp.minimum(
+        jnp.searchsorted(bounds, starts, side="right"),
+        E - 1).astype(jnp.int32)
+    return order, inv, tok_s, slot, wslot_pad, tile_expert, num_tiles
+
+
+def _routed_int8_kernel_path(x, weights, idx, quant: dict,
+                             row_tile: Optional[int] = None,
+                             interpret: bool = False):
+    """Metadata-only glue for the fused-routing kernel (decode regime).
+
+    Unlike ``_grouped_int8_kernel_path`` no activation row moves here:
+    the counting sort plus O(S) int32 slot arithmetic produce the
+    scalar-prefetch routing tables and the kernel does the gather /
+    combine itself (ops/pallas/moe_routed.py)."""
+    from llm_d_tpu.ops.pallas.moe_routed import routed_moe_int8
+    T, H = x.shape
+    k = idx.shape[1]
+    E = quant["w_gate_q"].shape[1]
+    S = T * k
+    if row_tile is None:
+        # Mean rows/expert governs the tile: small tiles bound the
+        # per-expert padding (the only waste left), larger tiles feed
+        # the MXU better once groups support them.
+        rt = int(os.environ.get("LLMD_MOE_ROUTED_ROW_TILE", "0")) \
+            or (32 if S < E * 96 else 64)
+    else:
+        rt = row_tile
+    flat = idx.reshape(S)
+    order, _, tok_s, slot, wslot_pad, tile_expert, num_tiles = \
+        _sorted_tile_layout(flat, weights.reshape(S), k, E, rt)
+    S_pad = wslot_pad.shape[0]
+    NT = S_pad // rt
+    # Pad slots keep token 0 with zero combine weight: they select a real
+    # row in the kernel's one-hot but contribute exactly nothing.
+    tok_pad = jnp.zeros((S_pad,), jnp.int32).at[slot].set(tok_s)
+    # bf16 sublane alignment for the resident x / output blocks.
+    Tp = -(-T // 16) * 16
+    x_p = x.astype(jnp.bfloat16)
+    if Tp != T:
+        x_p = jnp.pad(x_p, ((0, Tp - T), (0, 0)))
+    out = routed_moe_int8(
+        x_p, tok_pad[:, None], tok_pad.reshape(NT, rt), wslot_pad[:, None],
+        tile_expert, num_tiles, quant["layer"],
+        quant["w_gate_q"], quant["w_gate_s"],
+        quant["w_up_q"], quant["w_up_s"],
+        quant["w_down_q"], quant["w_down_s"],
+        row_tile=rt, interpret=interpret)
+    return out[:T].astype(x.dtype)
 
 
 def _grouped_int8_kernel_path(x, weights, idx, quant: dict,
@@ -231,17 +334,10 @@ def _grouped_int8_kernel_path(x, weights, idx, quant: dict,
         rt = 128 if S < E * 256 else 256
     else:
         rt = row_tile
-    # Static worst-case padding: every expert may round up to a tile, and
-    # S itself must round to a tile multiple (T*k need not be one).
-    S_pad = -(-S // rt) * rt + E * rt
     flat = idx.reshape(S)
-    order, sort_inv, counts = _stable_argsort_bounded(flat, E)
-    eid_s = flat[order]
-    tok_s = order // k
-    padded = -(-counts // rt) * rt
-    offs = _excl_cumsum(padded)
-    rank = jnp.arange(S, dtype=jnp.int32) - _excl_cumsum(counts)[eid_s]
-    dest = offs[eid_s] + rank
+    order, sort_inv, tok_s, dest, wslot_pad, tile_expert, _ = \
+        _sorted_tile_layout(flat, weights.reshape(S), k, E, rt)
+    S_pad = wslot_pad.shape[0]
     # Row data moves by GATHER only: big [*, H] scatters lower to
     # serialized updates on TPU, so the padded layout is built from 1-D
     # index scatters (cheap) + row gathers.  Padded slots point at the
@@ -250,16 +346,8 @@ def _grouped_int8_kernel_path(x, weights, idx, quant: dict,
     x_ext = jnp.concatenate(
         [x.astype(jnp.bfloat16), jnp.zeros((1, H), jnp.bfloat16)])
     x_pad = x_ext[src]                                    # [S_pad, H]
-    wslot_pad = jnp.zeros((S_pad, 1), jnp.float32).at[dest, 0].set(
-        weights.reshape(S)[order])
-    NT = S_pad // rt
-    bounds = jnp.cumsum(padded)
-    tile_expert = jnp.minimum(
-        jnp.searchsorted(bounds, jnp.arange(NT, dtype=jnp.int32) * rt,
-                         side="right"),
-        E - 1).astype(jnp.int32)
     y_pad = grouped_moe_int8(
-        x_pad, wslot_pad, tile_expert, quant["layer"],
+        x_pad, wslot_pad[:, None], tile_expert, quant["layer"],
         quant["w_gate_q"], quant["w_gate_s"],
         quant["w_up_q"], quant["w_up_s"],
         quant["w_down_q"], quant["w_down_s"],
@@ -513,7 +601,7 @@ def expert_ffn_a2a(
         return jax.lax.all_gather(
             out.astype(x.dtype), AXIS_EP, axis=0, tiled=True)
 
-    return jax.shard_map(
+    return shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(AXIS_EP), P(AXIS_EP), P(AXIS_EP),
                   P(AXIS_EP), P(AXIS_EP), P(AXIS_EP)),
@@ -556,14 +644,22 @@ def expert_ffn(
             dispatch = os.environ.get("LLMD_MOE_DISPATCH", "auto")
         if quant is not None and jax.default_backend() == "tpu" \
                 and dispatch == "auto":
-            # int8 kernel routing (an EXPLICIT dispatch override still gets
-            # the classic dequant paths below — the A/B lever).
-            min_t = int(os.environ.get("LLMD_MOE_GROUPED_MIN_T",
-                                       str(GROUPED_INT8_MIN_T)))
-            if x.shape[0] <= min_t:
+            # int8 kernel routing, three regimes (an EXPLICIT dispatch
+            # override still gets the classic dequant paths below — the
+            # A/B lever).  See the regime comment at DENSE_INT8_MAX_T.
+            dense_max = int(os.environ.get("LLMD_MOE_DENSE_KERNEL_MAX_T",
+                                           str(DENSE_INT8_MAX_T)))
+            grouped_min = int(os.environ.get("LLMD_MOE_GROUPED_MIN_T",
+                                             str(GROUPED_INT8_MIN_T)))
+            if x.shape[0] <= dense_max:
                 # Tiny batches: weight-bound; all-experts streaming wins.
                 return _dense_int8_kernel_path(x, weights, idx, quant)
-            # Compute-bound regime: grouped kernel does T*k rows, not T*E.
+            if x.shape[0] <= grouped_min:
+                # Decode regime: fused-routing kernel, T*k rows, zero
+                # XLA row glue (ops/pallas/moe_routed.py).
+                return _routed_int8_kernel_path(x, weights, idx, quant)
+            # Prefill regime: sorted+padded grouped kernel (x too big to
+            # sit VMEM-resident; glue amortizes over big tiles).
             return _grouped_int8_kernel_path(x, weights, idx, quant)
         if dispatch == "auto":
             max_t = int(os.environ.get("LLMD_MOE_DENSE_MAX_T",
@@ -606,7 +702,7 @@ def expert_ffn(
             x, weights, idx, w_gate, w_up, w_down, ep_rank * E_loc)
         return jax.lax.psum(out, AXIS_EP)
 
-    out = jax.shard_map(
+    out = shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(), P(), P(), P(AXIS_EP), P(AXIS_EP), P(AXIS_EP)),
         out_specs=P(),

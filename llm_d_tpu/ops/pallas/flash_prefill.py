@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_d_tpu.utils.jax_compat import CompilerParams
+
 NEG_INF = -1e30
 
 
@@ -225,7 +227,7 @@ def flash_prefill_paged(
         kernel,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((S, Q * H, D), qs.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(block_tables, seq_lens, layer_arr, q_fused, qpos_fused,

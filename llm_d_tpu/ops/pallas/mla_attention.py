@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_d_tpu.utils.jax_compat import CompilerParams
+
 from llm_d_tpu.ops.pallas.paged_attention import pick_seq_group
 
 NEG_INF = -1e30
@@ -233,7 +235,7 @@ def mla_paged_decode_update(
             jax.ShapeDtypeStruct(kv_cache.shape, kv_cache.dtype),
         ],
         input_output_aliases={5: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",), has_side_effects=True),
         interpret=interpret,
     )(block_tables, seq_lens, layer_arr, q_eff,

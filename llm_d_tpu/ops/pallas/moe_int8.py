@@ -44,6 +44,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_d_tpu.utils.jax_compat import CompilerParams
+
 
 def _grouped_kernel(
     layer_ref,    # [1]  SMEM (scalar prefetch: MoE-layer plane)
@@ -135,7 +137,7 @@ def grouped_moe_int8(
         _grouped_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S_pad, H), jnp.bfloat16),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(layer_arr, tile_expert, x_pad, wslot_pad,
@@ -215,7 +217,7 @@ def dense_moe_int8(
         _kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, H), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),   # sequential accumulation
         interpret=interpret,
     )(layer_arr, x, comb.T.astype(jnp.float32),

@@ -1,0 +1,178 @@
+"""Pallas TPU kernel: fused-routing grouped int8 MoE FFN (decode regime).
+
+The third point of the int8 MoE kernel family, built for the regime the
+other two lose in:
+
+  - ``dense_moe_int8`` computes every expert against every token — right
+    for tiny batches (weight-bound), an 8x routed-FLOPs overspend once
+    ``T x E`` work turns MXU-bound (measured r5: decode bs256 spends
+    9.47 of 16.8 ms/step there, 12% MFU / 36.9% HBM roofline).
+  - ``grouped_moe_int8`` computes only routed rows, but its XLA glue
+    (padded-row gather/scatter + unsort combine) moves every activation
+    row through HBM twice more and made the grouped route ~13% SLOWER
+    than dense at decode sizes (perf-notes-r5).
+
+This kernel keeps the grouped kernel's FLOP discipline and moves ALL
+row-data movement onto the MXU, inside the kernel:
+
+  - ``x`` stays in TOKEN order and is resident in VMEM for the whole
+    grid (decode batches are small: T <= ~512 is ~2 MB bf16) — one DMA,
+    no gathered/padded [S_pad, H] copy in HBM at all;
+  - per row tile, the sorted-by-expert row set is materialized by a
+    ONE-HOT GATHER MATMUL: ``onehot[rt, T] @ x[T, H]`` selects the
+    tile's tokens on the MXU (exact — selection of bf16 rows);
+  - the combine (un-sort + k-way sum + duplicate-route accumulation) is
+    the TRANSPOSED one-hot matmul ``onehot_T[T, rt] @ y[rt, H]``,
+    accumulated in f32 across the whole grid into the resident [T, H]
+    output block — no scatter, no unsort gather, no [S_pad, H] f32
+    round trip;
+  - routing metadata (counting-sort outputs: token id, combine weight
+    and expert id per sorted-padded slot) rides in as scalar-prefetch /
+    tiny 1-D blocks — the only per-layer XLA work left is the counting
+    sort itself plus O(S) int32 index arithmetic;
+  - experts with ZERO routed tokens get no tiles: the weight BlockSpec
+    index map simply never visits them (the skip the issue's EPLB /
+    small-batch layouts need), and trailing inactive tiles repeat the
+    previous index so Pallas skips their weight DMA too.
+
+The extra MXU work for the fused gather/scatter is 2*rt*T*H MACs per
+tile vs 3*rt*H*I for the FFN itself — ~T/I of the tile's FLOPs, a
+fraction of the 8x all-experts overspend it removes.  Weight traffic is
+identical to the dense kernel's one-pass stream (minus never-visited
+experts), so once the MXU term collapses the kernel runs at the weight
+roofline — the decode target.
+
+Reference role: DeepGEMM's ``m_grouped_gemm_fp8_fp8_bf16_nt_masked``
+(the low-latency-decode grouped GEMM; docker/Dockerfile.cuda:53-54,
+wide-ep decode.yaml:129-132).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from llm_d_tpu.utils.jax_compat import CompilerParams
+
+
+def _routed_kernel(
+    meta_ref,     # [2]  SMEM (scalar prefetch: [layer plane, num_tiles])
+    te_ref,       # [NT] SMEM (scalar prefetch: expert id per row tile)
+    x_ref,        # [Tp, H] bf16 (whole token batch; same block every step)
+    tokc_ref,     # [RT, 1] i32  token id per sorted-padded slot (column)
+    tokr_ref,     # [1, RT] i32  same metadata, row layout (for onehot_T)
+    wslot_ref,    # [RT, 1] f32  combine weight per slot (0 = pad)
+    wg_ref,       # [1, 1, H, I] int8 (this tile's expert)
+    wu_ref,       # [1, 1, H, I] int8
+    wd_ref,       # [1, 1, I, H] int8
+    gs_ref,       # [1, 1, 1, I] f32
+    us_ref,       # [1, 1, 1, I] f32
+    ds_ref,       # [1, 1, 1, H] f32
+    o_ref,        # [Tp, H] f32 (accumulated across the whole grid)
+):
+    t = pl.program_id(0)
+    Tp = x_ref.shape[0]
+    RT = tokc_ref.shape[0]
+
+    @pl.when(t == 0)
+    def _():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    # Inactive trailing tiles (static grid, dynamic tile count): their
+    # metadata is zeroed and their weight index map repeats, so skipping
+    # compute is purely an optimization — the contribution would be 0.
+    @pl.when(t < meta_ref[1])
+    def _():
+        tok_c = tokc_ref[...]                              # [RT, 1]
+        tok_r = tokr_ref[...]                              # [1, RT]
+        # Gather matmul: one-hot row selector (exact for bf16 payloads).
+        sel = (tok_c == jax.lax.broadcasted_iota(
+            jnp.int32, (RT, Tp), 1)).astype(jnp.bfloat16)  # [RT, Tp]
+        xg = jax.lax.dot(sel, x_ref[...],
+                         preferred_element_type=jnp.bfloat16)   # [RT, H]
+        wg = wg_ref[0, 0].astype(jnp.bfloat16)             # exact |q|<=127
+        wu = wu_ref[0, 0].astype(jnp.bfloat16)
+        h = jax.lax.dot(xg, wg,
+                        preferred_element_type=jnp.float32) * gs_ref[0, 0]
+        u = jax.lax.dot(xg, wu,
+                        preferred_element_type=jnp.float32) * us_ref[0, 0]
+        a = jax.nn.silu(h) * u * wslot_ref[...]            # [RT, I] f32
+        wd = wd_ref[0, 0].astype(jnp.bfloat16)
+        y = jax.lax.dot(a.astype(jnp.bfloat16), wd,
+                        preferred_element_type=jnp.float32) * ds_ref[0, 0]
+        # Combine matmul: transposed one-hot un-sorts, k-sums and merges
+        # duplicate routes in one accumulating MXU pass.
+        sel_t = (tok_r == jax.lax.broadcasted_iota(
+            jnp.int32, (Tp, RT), 0)).astype(jnp.bfloat16)  # [Tp, RT]
+        o_ref[...] += jax.lax.dot(sel_t, y.astype(jnp.bfloat16),
+                                  preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("row_tile", "interpret"))
+def routed_moe_int8(
+    x: jax.Array,           # [Tp, H] bf16 — token order (Tp: T padded to 16)
+    tok_pad: jax.Array,     # [S_pad, 1] i32 token id per sorted-padded slot
+    tok_row: jax.Array,     # [NT, RT] i32 same metadata, one row per tile
+    wslot_pad: jax.Array,   # [S_pad, 1] f32 combine weights (0 = pad slot)
+    tile_expert: jax.Array, # [NT] i32 expert id per tile (repeats when idle)
+    num_tiles,              # scalar int32: tiles actually populated
+    layer,                  # scalar int32: plane of the stacked weights
+    w_gate_q: jax.Array,    # [Lm, E, H, I] int8
+    w_gate_s: jax.Array,    # [Lm, E, 1, I] f32
+    w_up_q: jax.Array,
+    w_up_s: jax.Array,
+    w_down_q: jax.Array,    # [Lm, E, I, H] int8
+    w_down_s: jax.Array,    # [Lm, E, 1, H] f32
+    row_tile: int = 32,
+    interpret: bool = False,
+) -> jax.Array:             # [Tp, H] f32 — routed MoE output, token order
+    """Fused-routing grouped int8 MoE FFN over stacked weights.
+
+    The caller owns ONLY the counting sort and int32 slot arithmetic
+    (``ops.moe._routed_int8_kernel_path``); every activation row moves
+    inside the kernel.  Output is already combined per token — no unsort,
+    no scatter, no [T, k, H] reduction outside.
+    """
+    Tp, H = x.shape
+    S_pad = tok_pad.shape[0]
+    Lm, E, _, I = w_gate_q.shape
+    assert S_pad % row_tile == 0
+    NT = S_pad // row_tile
+    assert tok_row.shape == (NT, row_tile)
+    assert tile_expert.shape == (NT,)
+    meta = jnp.stack([jnp.asarray(layer, jnp.int32),
+                      jnp.asarray(num_tiles, jnp.int32)])
+
+    def wmap(t, meta_ref, te_ref):
+        return (meta_ref[0], te_ref[t], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(NT,),
+        in_specs=[
+            pl.BlockSpec((Tp, H), lambda t, *_: (0, 0)),        # x resident
+            pl.BlockSpec((row_tile, 1), lambda t, *_: (t, 0)),  # tok col
+            pl.BlockSpec((1, row_tile), lambda t, *_: (t, 0)),  # tok row
+            pl.BlockSpec((row_tile, 1), lambda t, *_: (t, 0)),  # wslot
+            pl.BlockSpec((1, 1, H, I), wmap),
+            pl.BlockSpec((1, 1, H, I), wmap),
+            pl.BlockSpec((1, 1, I, H), wmap),
+            pl.BlockSpec((1, 1, 1, I), wmap),
+            pl.BlockSpec((1, 1, 1, I), wmap),
+            pl.BlockSpec((1, 1, 1, H), wmap),
+        ],
+        out_specs=pl.BlockSpec((Tp, H), lambda t, *_: (0, 0)),
+    )
+    return pl.pallas_call(
+        _routed_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Tp, H), jnp.float32),
+        compiler_params=CompilerParams(
+            dimension_semantics=("arbitrary",)),   # sequential accumulation
+        interpret=interpret,
+    )(meta, tile_expert, x, tok_pad, tok_row, wslot_pad,
+      w_gate_q, w_up_q, w_down_q, w_gate_s, w_up_s, w_down_s)

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_d_tpu.utils.jax_compat import CompilerParams
+
 NEG_INF = -1e30
 
 
@@ -309,7 +311,7 @@ def paged_attention_decode_update(
             jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
         ],
         input_output_aliases={6: 1, 7: 2},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",), has_side_effects=True),
         interpret=interpret,
     )(block_tables, seq_lens, layer_arr, q,

@@ -32,6 +32,8 @@ from typing import Callable, Dict, Tuple
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from llm_d_tpu.utils.jax_compat import shard_map
+
 # Batch arrays attention consumes; all are per-shard in stacked mode.
 ATTN_BATCH_KEYS = ("positions", "token_seq_ids", "token_qpos",
                    "slot_mapping", "block_tables", "seq_lens", "qtok_idx")
@@ -67,7 +69,7 @@ def dp_attend(
         return a[None], tuple(c[None] for c in new_caches)
 
     dp = P("dp")
-    return jax.shard_map(
+    return shard_map(
         body, mesh=mesh,
         in_specs=(P(), dp, (dp,) * n_cache, {k: dp for k in ab}, P()),
         out_specs=(dp, (dp,) * n_cache),

@@ -67,32 +67,59 @@ class DPWorkerPool:
     The leader host serves ALL external traffic; each request either runs
     on the local ``DPEngineGroup`` or is proxied verbatim to a worker
     host's API server (the "RPC" is the same OpenAI HTTP surface — one
-    wire format end to end).  Policy is least-outstanding-work: local load
-    from the engine's scheduler, worker load from the leader's own
-    in-flight proxy count.  With ``--data-parallel-hybrid-lb`` no pool
-    exists: every host takes external traffic and balances only its local
-    ranks (the external LB spreads hosts), decode.yaml:75,86.
+    wire format end to end).  Policy is least-outstanding-work over
+    COMPARABLE loads (VERDICT r5 #8): both sides count scheduler depth
+    (waiting + running requests).  Local depth comes straight from the
+    engine; worker depth is worker-REPORTED — every inference response
+    carries an ``x-llmd-sched-depth`` header sampled from the worker's
+    own scheduler — plus the leader's count of dispatches whose response
+    headers haven't arrived yet (requests the last report can't see).
+    The previous policy compared the leader-side in-flight HTTP count,
+    under which one long-lived SSE stream pinned a worker at load=1 for
+    its whole life while its scheduler sat empty, over-serving the
+    leader under streaming-heavy traffic.  With
+    ``--data-parallel-hybrid-lb`` no pool exists: every host takes
+    external traffic and balances only its local ranks (the external LB
+    spreads hosts), decode.yaml:75,86.
     """
 
     WORKER_BACKOFF_S = 15.0
+    DEPTH_HEADER = "x-llmd-sched-depth"
 
     def __init__(self, workers: List[str]) -> None:
-        self.workers = [{"url": u.rstrip("/"), "inflight": 0, "down_until": 0.0}
+        # inflight: open proxied HTTP exchanges (metrics only, NOT load);
+        # dispatching: sequence ids of dispatches no depth report has
+        # covered yet (see load()); depth: the worker's last
+        # self-reported scheduler depth; seq: dispatch counter.
+        self.workers = [{"url": u.rstrip("/"), "inflight": 0,
+                         "dispatching": set(), "seq": 0,
+                         "depth": 0, "down_until": 0.0}
                         for u in workers if u.strip()]
         self._session = None
+
+    @staticmethod
+    def load(worker: dict) -> int:
+        """Comparable worker load: last reported scheduler depth + the
+        dispatches no report has counted yet.  A dispatch leaves the
+        ``dispatching`` set when its OWN headers arrive or when a report
+        from a LATER dispatch lands (that report was sampled after this
+        older dispatch reached the worker, so its depth already includes
+        it — keeping it would double-count every in-flight dispatch
+        older than the freshest report)."""
+        return worker["depth"] + len(worker["dispatching"])
 
     def pick(self, engine) -> Optional[dict]:
         """Returns the worker to proxy to, or None to serve locally.
         Workers that recently failed to connect are skipped until their
         backoff expires — a dead pod must not keep winning the
-        least-inflight race while its requests all 500."""
+        least-loaded race while its requests all 500."""
         now = time.monotonic()
         live = [w for w in self.workers if w["down_until"] <= now]
         if not live:
             return None
         local = engine.scheduler.num_waiting + engine.scheduler.num_running
-        best = min(live, key=lambda w: w["inflight"])
-        return best if best["inflight"] < local else None
+        best = min(live, key=self.load)
+        return best if self.load(best) < local else None
 
     async def proxy(self, request: web.Request, body: Dict[str, Any],
                     worker: dict) -> Optional[web.StreamResponse]:
@@ -106,6 +133,11 @@ class DPWorkerPool:
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=None, sock_connect=5))
         worker["inflight"] += 1
+        seq = worker["seq"]
+        worker["seq"] += 1
+        worker["dispatching"].add(seq)
+        headers_seen = False
+        counted_self = False
         resp = None
         # Forward end-to-end headers both ways (auth, tracing, accept —
         # proxied and locally-served requests must be indistinguishable
@@ -120,6 +152,27 @@ class DPWorkerPool:
             async with self._session.post(
                     worker["url"] + request.path_qs, json=body,
                     headers=fwd_headers) as upstream:
+                # Response headers arrived: this dispatch is now visible
+                # in the worker's own depth report (or finished) — and so
+                # is every OLDER dispatch, which reached the worker before
+                # this response left it (see load()).
+                depth = upstream.headers.get(self.DEPTH_HEADER)
+                worker["dispatching"] = {
+                    p for p in worker["dispatching"] if p > seq}
+                headers_seen = True
+                # Streaming reports leave at stream START and count the
+                # request itself; when the exchange ends we know it left
+                # the worker's scheduler, so take it back out — otherwise
+                # a finished stream leaves the worker looking loaded
+                # until the next report.  Non-streaming reports leave at
+                # completion and already exclude themselves.
+                counted_self = upstream.headers.get(
+                    "Content-Type", "").startswith("text/event-stream")
+                if depth is not None:
+                    try:
+                        worker["depth"] = max(0, int(depth))
+                    except ValueError:
+                        pass
                 resp = web.StreamResponse(
                     status=upstream.status,
                     headers={k: v for k, v in upstream.headers.items()
@@ -138,6 +191,10 @@ class DPWorkerPool:
             raise                    # mid-stream: the client sees the break
         finally:
             worker["inflight"] -= 1
+            if not headers_seen:
+                worker["dispatching"].discard(seq)
+            elif counted_self:
+                worker["depth"] = max(0, worker["depth"] - 1)
 
     async def close(self) -> None:
         if self._session is not None:
@@ -352,9 +409,13 @@ class ModelServer:
         }
 
         if stream:
+            # Depth report for the leader's DP pool (see DPWorkerPool):
+            # headers leave BEFORE this request is admitted, so count it
+            # explicitly (+1) — the value a fresh scrape would see.
             resp = web.StreamResponse(headers={
                 "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache"})
+                "Cache-Control": "no-cache",
+                DPWorkerPool.DEPTH_HEADER: str(self._sched_depth() + 1)})
             await resp.prepare(http_req)
             all_text_len = 0
             async for out in self.async_engine.generate(req):
@@ -448,7 +509,16 @@ class ModelServer:
         if final_out is not None and final_out.kv_transfer_params:
             payload["kv_transfer_params"] = final_out.kv_transfer_params
         self._post_training_sample(req, arrival_feats)
-        return web.json_response(payload)
+        # Non-streaming: this request already left the scheduler — the
+        # depth reported is everyone still queued/running behind it.
+        return web.json_response(payload, headers={
+            DPWorkerPool.DEPTH_HEADER: str(self._sched_depth())})
+
+    def _sched_depth(self) -> int:
+        """Scheduler depth (waiting + running) — the worker-side half of
+        the DP pool's comparable-load contract."""
+        s = self.engine.scheduler
+        return int(s.num_waiting + s.num_running)
 
     def _apply_stop_strings(self, req: Request, delta: str, full: str):
         """Truncate output at the first stop string. Returns (delta', stopped)."""

@@ -1,0 +1,92 @@
+"""Version compatibility shims for the pinned JAX.
+
+The codebase targets the modern public APIs (``jax.shard_map`` with
+``check_vma``/``axis_names``, ``pltpu.CompilerParams``); the pinned
+runtime (JAX 0.4.37) still ships the experimental predecessors
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``/``auto``,
+``pltpu.TPUCompilerParams``).  This module is the ONE place that knows
+about the renames — every call site imports from here, so bumping the
+pin later means deleting shims, not editing kernels.
+
+Mapping notes:
+
+  - ``check_vma`` (new) == ``check_rep`` (old): both gate the replication
+    /varying-manual-axes check; the repo always passes False (the manual
+    bodies do their own psums).
+  - ``axis_names`` (new) lists the axes the body is MANUAL over; the old
+    API's ``auto`` lists the axes that stay AUTOMATIC.  They are exact
+    complements over the mesh's axis names.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Set
+
+import jax
+
+try:  # modern JAX: public API with check_vma / axis_names
+    _new_shard_map = jax.shard_map          # type: ignore[attr-defined]
+except AttributeError:
+    _new_shard_map = None
+
+if _new_shard_map is None:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs,
+              check_vma: bool = True,
+              axis_names: Optional[Set[str]] = None):
+    """``jax.shard_map`` facade that runs on both old and new JAX.
+
+    ``axis_names`` (when given) is the set of mesh axes the body is
+    manual over — remaining axes stay auto (partial-manual mode).
+    """
+    if _new_shard_map is not None:
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma,
+                              **kw)
+    kw = {}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kw)
+
+
+@functools.lru_cache(maxsize=1)
+def tpu_compiler_params_cls():
+    """The Pallas TPU compiler-params class under its current name."""
+    from jax.experimental.pallas import tpu as pltpu
+    return getattr(pltpu, "CompilerParams",
+                   getattr(pltpu, "TPUCompilerParams", None))
+
+
+# Fields that legitimately differ across the supported JAX versions and
+# may be dropped when the pinned class lacks them.  ``has_side_effects``
+# (absent from 0.4.37's TPUCompilerParams) is safe to drop: the kernels
+# that pass it also alias their cache buffers in-place AND return them,
+# so the old API cannot dead-code-eliminate them anyway.
+_COMPILER_PARAMS_VERSION_SKEW = frozenset({"has_side_effects"})
+
+
+def CompilerParams(**kwargs):  # noqa: N802  (class-style factory)
+    """``pltpu.CompilerParams(...)`` under either JAX spelling.
+
+    Only known version-skew fields are dropped when the pinned class
+    lacks them; anything else unknown (a typo, a genuinely required new
+    field) still fails loudly.
+    """
+    import dataclasses
+    cls = tpu_compiler_params_cls()
+    if cls is None:  # pragma: no cover - ancient/foreign pallas builds
+        raise ImportError("no Pallas TPU CompilerParams class available")
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(kwargs) - known
+    if unknown - _COMPILER_PARAMS_VERSION_SKEW:
+        raise TypeError(
+            f"{cls.__name__} got unexpected fields "
+            f"{sorted(unknown - _COMPILER_PARAMS_VERSION_SKEW)}")
+    return cls(**{k: v for k, v in kwargs.items() if k in known})

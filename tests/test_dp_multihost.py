@@ -134,16 +134,101 @@ def test_pool_policy_least_outstanding():
 
     # Idle local: serve locally.
     assert pool.pick(Eng()) is None
-    # Loaded local, idle workers: go remote (least-inflight worker).
+    # Loaded local, idle workers: go remote (least-loaded worker:
+    # reported scheduler depth + not-yet-reported dispatches).
     Sched.num_running = 3
-    pool.workers[0]["inflight"] = 2
+    pool.workers[0]["depth"] = 1
+    pool.workers[0]["dispatching"] = {0}
     w = pool.pick(Eng())
     assert w is pool.workers[1]
     # Everyone busier than local: stay local.
-    pool.workers[0]["inflight"] = 5
-    pool.workers[1]["inflight"] = 4
+    pool.workers[0]["depth"] = 5
+    pool.workers[1]["depth"] = 4
     Sched.num_running = 2
     assert pool.pick(Eng()) is None
+
+
+def test_pool_streaming_load_is_scheduler_depth_not_inflight():
+    """VERDICT r5 #8 regression test: a long-lived SSE stream keeps the
+    leader-side HTTP exchange open (inflight=1) for its whole life, but
+    once the worker reported its (empty-again) scheduler depth the pool
+    must treat the worker as IDLE — the old policy compared inflight and
+    over-served the leader under streaming-heavy traffic."""
+    pool = DPWorkerPool(["http://w1"])
+    w = pool.workers[0]
+
+    class Sched:
+        num_waiting, num_running = 1, 1
+
+    class Eng:
+        scheduler = Sched()
+
+    # A stream is mid-flight: headers long since arrived (dispatching
+    # drained, depth reported at stream start), exchange still open.
+    w["inflight"] = 1
+    w["dispatching"] = set()
+    w["depth"] = 0
+    assert DPWorkerPool.load(w) == 0
+    # Local has queued work -> the streaming worker must still win.
+    assert pool.pick(Eng()) is w
+    # Dispatches no report has seen yet count as load again.
+    w["dispatching"] = {5, 6}
+    assert DPWorkerPool.load(w) == 2
+    assert pool.pick(Eng()) is None
+
+
+def test_depth_header_reported_and_consumed(two_hosts):
+    """Every inference response carries x-llmd-sched-depth (the worker's
+    own scheduler depth), and the leader's proxy folds it into the
+    worker's load state."""
+    leader, worker, lp, wp = two_hosts
+    # Direct hit on the worker: header present, parseable, >= 0.
+    r = requests.post(f"http://127.0.0.1:{wp}/v1/completions",
+                      json={"prompt": [3, 1, 4], "max_tokens": 2,
+                            "temperature": 0}, timeout=60)
+    assert int(r.headers[DPWorkerPool.DEPTH_HEADER]) >= 0
+    # Streaming responses report too (counting themselves).
+    r = requests.post(f"http://127.0.0.1:{wp}/v1/completions",
+                      json={"prompt": [3, 1, 4], "max_tokens": 2,
+                            "temperature": 0, "stream": True},
+                      timeout=60, stream=True)
+    assert int(r.headers[DPWorkerPool.DEPTH_HEADER]) >= 1
+    r.close()
+    # Through the leader: force a proxied request; the pool's depth state
+    # must reflect the worker's report (idle again once finished).
+    pool = leader.dp_pool
+    pool.workers[0]["depth"] = 99   # stale garbage the report must fix
+    orig = pool.pick
+    pool.pick = lambda engine: pool.workers[0]
+    try:
+        requests.post(f"http://127.0.0.1:{lp}/v1/completions",
+                      json={"prompt": [2, 7, 1], "max_tokens": 2,
+                            "temperature": 0}, timeout=60)
+    finally:
+        pool.pick = orig
+    assert pool.workers[0]["depth"] < 99
+    assert pool.workers[0]["dispatching"] == set()
+    # Proxied SSE stream: its start header counted itself (depth >= 1
+    # while streaming); once the exchange completes the proxy must take
+    # it back out — a finished stream must NOT leave the worker looking
+    # loaded until the next report (the r5 #8 failure mode, again).
+    pool.pick = lambda engine: pool.workers[0]
+    try:
+        r = requests.post(f"http://127.0.0.1:{lp}/v1/completions",
+                          json={"prompt": [2, 7, 1], "max_tokens": 3,
+                                "temperature": 0, "stream": True},
+                          timeout=60, stream=True)
+        list(r.iter_content())      # drain to completion
+        r.close()
+    finally:
+        pool.pick = orig
+    for _ in range(50):             # leader's finally runs async-soon
+        if pool.workers[0]["depth"] == 0:
+            break
+        import time
+        time.sleep(0.1)
+    assert pool.workers[0]["depth"] == 0
+    assert pool.workers[0]["dispatching"] == set()
 
 
 def test_worker_url_derivation_and_cli():

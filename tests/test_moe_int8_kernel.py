@@ -147,23 +147,158 @@ def test_grouped_kernel_matches_dequant_oracle(T, E, H, I, rt):
                                np.asarray(want) / scale, atol=8e-3)
 
 
+def _rand_quant(key, E, H, I, Lm=2, plane=1):
+    """Stacked int8 payloads addressing plane 1 (exercises the
+    scalar-prefetch layer indexing) + the dequantized plane for oracles."""
+    ks = jax.random.split(key, 3)
+    stack = lambda a: jnp.stack([jnp.zeros_like(a), a])
+    quant = {"layer": jnp.int32(plane)}
+    deq = {}
+    for name, kk, shape in (("w_gate", ks[0], (E, H, I)),
+                            ("w_up", ks[1], (E, H, I)),
+                            ("w_down", ks[2], (E, I, H))):
+        q, s = quantize_int8(
+            jax.random.normal(kk, shape, jnp.float32) * 0.05)
+        quant[f"{name}_q"], quant[f"{name}_s"] = stack(q), stack(s)
+        deq[name] = dequantize(q, s)
+    return quant, (deq["w_gate"], deq["w_up"], deq["w_down"])
+
+
+def _assert_routed_matches_oracle(x, w, idx, quant, deq, rt=None):
+    from llm_d_tpu.ops import moe as moe_ops
+    got = moe_ops._routed_int8_kernel_path(
+        x, w, idx, quant, row_tile=rt, interpret=True)
+    want = moe_ops._local_expert_ffn(x, w, idx, *deq, jnp.int32(0))
+    scale = float(jnp.max(jnp.abs(np.asarray(want)))) + 1e-9
+    np.testing.assert_allclose(np.asarray(got, np.float32) / scale,
+                               np.asarray(want, np.float32) / scale,
+                               atol=8e-3)
+
+
+@pytest.mark.parametrize("T,E,H,I,k,rt", [
+    (16, 8, 256, 128, 2, 8),     # tiny decode batch
+    (36, 8, 256, 128, 2, 16),    # T not a multiple of the bf16 sublane (16)
+    (64, 4, 512, 256, 4, 32),    # multi-tile groups
+    (48, 16, 256, 128, 8, 16),   # S = T*k >> E: every expert multi-row
+])
+def test_routed_kernel_matches_dequant_oracle(T, E, H, I, k, rt):
+    """Fused-routing kernel (in-kernel one-hot gather/combine) == routed
+    dequant oracle, through the ACTUAL glue (_routed_int8_kernel_path:
+    counting sort, slot arithmetic, tile_expert map) in interpret mode.
+    The routed-only math must equal the XLA dense-combine reference."""
+    key = jax.random.PRNGKey(11)
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jax.random.randint(ks[1], (T, k), 0, E)
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[3], E, H, I)
+    _assert_routed_matches_oracle(x, w, idx, quant, deq, rt=rt)
+
+
+def test_routed_kernel_empty_expert_groups():
+    """Routing concentrated on 3 of 16 experts: the 13 empty groups get
+    ZERO tiles (their weights are never addressed) and the output still
+    matches the oracle — the empty-group skip the EPLB-sharded and
+    small-batch layouts rely on."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    key = jax.random.PRNGKey(13)
+    T, E, H, I, k = 32, 16, 256, 128, 2
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    hot = jnp.asarray([1, 7, 12], jnp.int32)
+    idx = hot[jax.random.randint(ks[1], (T, k), 0, 3)]
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[3], E, H, I)
+    _assert_routed_matches_oracle(x, w, idx, quant, deq, rt=16)
+    # The tile map must reference only populated experts: with 3 hot
+    # experts and rt=16, every active tile belongs to {1, 7, 12}, and
+    # the inactive trailing tiles REPEAT the last active tile's expert
+    # (same weight index map -> Pallas skips their DMA; a clamp to E-1
+    # would stream an unused expert's weights).
+    rt, S = 16, T * k
+    _, _, _, _, _, tile_e, num_tiles = moe_ops._sorted_tile_layout(
+        idx.reshape(S), w.reshape(S), k, E, rt)
+    nt = int(num_tiles)
+    active = np.asarray(tile_e[:nt])
+    assert set(active.tolist()) == {1, 7, 12}
+    assert np.all(np.asarray(tile_e[nt:]) == active[-1])
+
+
+def test_routed_kernel_duplicate_routes_accumulate():
+    """A token routed to the SAME expert in two slots contributes the sum
+    of both combine weights (the transposed one-hot merges duplicates)."""
+    key = jax.random.PRNGKey(17)
+    T, E, H, I = 16, 4, 256, 128
+    ks = jax.random.split(key, 3)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jnp.stack([jnp.full((T,), 2, jnp.int32),
+                     jnp.full((T,), 2, jnp.int32)], axis=1)
+    w = jnp.abs(jax.random.normal(ks[1], (T, 2), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[2], E, H, I)
+    _assert_routed_matches_oracle(x, w, idx, quant, deq, rt=8)
+
+
+def test_routed_kernel_eplb_physical_layout():
+    """Routed kernel under an EPLB replica table: logical ids map to
+    physical slots (to_physical_experts), replicas carry the SAME weights,
+    and the kernel over the physical layout matches the logical oracle."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    key = jax.random.PRNGKey(19)
+    T, E_log, H, I, k = 24, 4, 256, 128, 2
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jax.random.randint(ks[1], (T, k), 0, E_log)
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[3], E_log, H, I)
+
+    # Physical layout: expert 1 gets a replica in slot 4, expert 3 in
+    # slot 5 (E_phys = 6); replica weights are copies of the logical.
+    replica_table = jnp.asarray(
+        [[0, 0], [1, 4], [2, 2], [3, 5]], jnp.int32)
+    num_replicas = jnp.asarray([1, 2, 1, 2], jnp.int32)
+    phys_of = [0, 1, 2, 3, 1, 3]
+    quant_phys = dict(quant)
+    for name in ("w_gate", "w_up", "w_down"):
+        for suf in ("_q", "_s"):
+            a = quant[name + suf]
+            quant_phys[name + suf] = a[:, jnp.asarray(phys_of)]
+    phys_idx = moe_ops.to_physical_experts(idx, replica_table, num_replicas)
+    assert int(phys_idx.max()) >= E_log  # replicas actually exercised
+
+    got = moe_ops._routed_int8_kernel_path(
+        x, w, phys_idx, quant_phys, row_tile=8, interpret=True)
+    want = moe_ops._local_expert_ffn(x, w, idx, *deq, jnp.int32(0))
+    scale = float(jnp.max(jnp.abs(np.asarray(want)))) + 1e-9
+    np.testing.assert_allclose(np.asarray(got, np.float32) / scale,
+                               np.asarray(want, np.float32) / scale,
+                               atol=8e-3)
+
+
 def test_grouped_kernel_routing_thresholds(monkeypatch):
-    """expert_ffn routes: T <= LLMD_MOE_GROUPED_MIN_T -> dense streaming
-    kernel; larger T -> grouped kernel (TPU backend only)."""
+    """expert_ffn int8 routing, three regimes: T <= DENSE_INT8_MAX_T ->
+    dense streaming kernel; T <= GROUPED_INT8_MIN_T -> fused-routing
+    routed kernel (decode); larger T -> sorted+padded grouped kernel
+    (prefill).  TPU backend only."""
     from llm_d_tpu.ops import moe as moe_ops
 
     calls = []
     monkeypatch.setattr(moe_ops, "_dense_int8_kernel_path",
                         lambda x, *a, **kw: calls.append("dense") or x)
+    monkeypatch.setattr(moe_ops, "_routed_int8_kernel_path",
+                        lambda x, *a, **kw: calls.append("routed") or x)
     monkeypatch.setattr(moe_ops, "_grouped_int8_kernel_path",
                         lambda x, *a, **kw: calls.append("grouped") or x)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     quant = dict(w_gate_q=jnp.zeros((1, 4, 8, 8), jnp.int8))
-    lo = moe_ops.GROUPED_INT8_MIN_T          # <= threshold -> dense
-    hi = 2 * moe_ops.GROUPED_INT8_MIN_T      # above -> grouped
-    for T in (lo, hi):
+    ts = (moe_ops.DENSE_INT8_MAX_T,          # <= lower bound -> dense
+          moe_ops.DENSE_INT8_MAX_T + 1,      # decode window -> routed
+          moe_ops.GROUPED_INT8_MIN_T,        # window top -> routed
+          moe_ops.GROUPED_INT8_MIN_T + 1)    # above -> grouped
+    for T in ts:
         moe_ops.expert_ffn(jnp.ones((T, 8), jnp.bfloat16),
                            jnp.ones((T, 2), jnp.float32),
                            jnp.zeros((T, 2), jnp.int32),
                            None, None, None, quant=quant)
-    assert calls == ["dense", "grouped"]
+    assert calls == ["dense", "routed", "routed", "grouped"]
