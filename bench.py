@@ -98,12 +98,15 @@ def _active_param_count(c) -> int:
 
 
 def _run_workload(engine, reqs):
-    """Returns (prefill_seconds, decode_seconds, decode_tokens)."""
+    """Returns (prefill_seconds, prefill_steps, decode_seconds,
+    decode_tokens)."""
     for r in reqs:
         engine.add_request(r)
+    n_prefill_steps = 0
     t0 = time.perf_counter()
     while any(r.num_computed_tokens < r.num_prompt_tokens for r in reqs):
         engine.step()
+        n_prefill_steps += 1
     t_prefill = time.perf_counter() - t0
 
     tokens_before = sum(len(r.output_token_ids) for r in reqs)
@@ -112,7 +115,7 @@ def _run_workload(engine, reqs):
         engine.step()
     t_decode = time.perf_counter() - t1
     tokens_after = sum(len(r.output_token_ids) for r in reqs)
-    return t_prefill, t_decode, tokens_after - tokens_before
+    return t_prefill, n_prefill_steps, t_decode, tokens_after - tokens_before
 
 
 def _make_reqs(tag, n, prompt_len, decode_steps, offset):
@@ -182,12 +185,14 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
             f"warm{bs}", bs, prompt_len, decode_steps, 50000 + offset))
         n_rep = (repeats or {}).get(bs, 1)
         prefill_runs, decode_runs = [], []
+        n_prefill_steps = 1
         for rep in range(n_rep):
             # Disjoint token ids per repeat: identical-argument jitted
             # calls can be served from a remote cache (perf-notes-r5).
-            t_prefill, t_decode, decode_tokens = _run_workload(
-                engine, _make_reqs(f"bench{bs}r{rep}", bs, prompt_len,
-                                   decode_steps, offset + 97 * rep))
+            t_prefill, n_prefill_steps, t_decode, decode_tokens = \
+                _run_workload(
+                    engine, _make_reqs(f"bench{bs}r{rep}", bs, prompt_len,
+                                       decode_steps, offset + 97 * rep))
             prefill_runs.append(bs * prompt_len / t_prefill)
             decode_runs.append(decode_tokens / t_decode)
         prompt_tokens = bs * prompt_len
@@ -212,6 +217,12 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
             "decode_hbm_roofline_pct": round(
                 100 * decode_tok_s / roofline_tok_s, 1),
             "decode_ms_per_step": round(1000 * t_decode / decode_steps, 2),
+            # Per-ENGINE-step prefill cost (chunked prefill: a step is
+            # one max_num_batched_tokens-bounded forward) — the unit the
+            # attribution table differences, matching decode_ms_per_step.
+            "prefill_ms_per_step": round(
+                1000 * t_prefill / max(n_prefill_steps, 1), 2),
+            "prefill_steps": n_prefill_steps,
         }
         if n_rep > 1:
             out[bs]["decode_tok_s_runs"] = [round(v, 1) for v in decode_runs]
@@ -220,6 +231,13 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
             out[bs]["decode_band_spread_pct"] = round(
                 100 * (max(decode_runs) - min(decode_runs))
                 / max(decode_tok_s, 1e-9), 1)
+            out[bs]["prefill_tok_s_runs"] = [round(v, 1)
+                                             for v in prefill_runs]
+            out[bs]["prefill_tok_s_band"] = [round(min(prefill_runs), 1),
+                                             round(max(prefill_runs), 1)]
+            out[bs]["prefill_band_spread_pct"] = round(
+                100 * (max(prefill_runs) - min(prefill_runs))
+                / max(prefill_tok_s, 1e-9), 1)
     out["param_bytes"] = param_bytes
     return out
 
@@ -344,7 +362,8 @@ def v5p256_sensitivity(measured_roofline_frac: float) -> dict:
 
 
 def _regression_gate(dense: dict, moe: dict) -> dict:
-    """Band-aware regression gate over the two headline metrics.
+    """Band-aware regression gate over the THREE headline metrics (two
+    decode, one prefill — prefill regressions used to land silently).
 
     ``*_delta_pct`` is the MEDIAN's delta vs the best recorded number;
     ``*_regressed`` is True only when the run band's MAX is below it —
@@ -352,17 +371,23 @@ def _regression_gate(dense: dict, moe: dict) -> dict:
     ±4-6% noise band cannot explain.  Gate on ``*_regressed``, read
     ``*_delta_pct`` for trend."""
     gate = {}
-    for name, sweep, bs, best in (
-            ("dense_bs64", dense, 64, 11196.7),    # BENCH_r03
-            ("moe_bs256", moe, 256, 16060.6)):     # r5 final (wb pipelining)
+    for name, sweep, bs, phase, best in (
+            ("dense_bs64", dense, 64, "decode", 11196.7),   # BENCH_r03
+            ("moe_bs256", moe, 256, "decode", 16060.6),     # r5 final
+            # BENCH_r05 moe bs64 prefill (the 11.46%-MFU number the
+            # streamed kernel exists to beat).
+            ("moe_prefill_tok_s_bs64", moe, 64, "prefill", 17105.1)):
         gate[f"{name}_best_recorded"] = best
         if bs not in sweep:
             gate[f"{name}_delta_pct"] = None
             continue
         row = sweep[bs]
-        med = row["decode_tok_s"]
+        med = row[f"{phase}_tok_s"]
         gate[f"{name}_delta_pct"] = round(100 * (med / best - 1), 1)
-        band = row.get("decode_tok_s_band")
+        if phase == "prefill" and f"{phase}_mfu_pct" in row:
+            # The ≥20% prefill-MFU target rides along with the verdict.
+            gate[f"{name}_mfu_pct"] = row[f"{phase}_mfu_pct"]
+        band = row.get(f"{phase}_tok_s_band")
         if band is None:
             # Single sample (--quick / --gate-repeats 1): a point inside
             # the ±4-6% noise band must not be called a regression — no
@@ -374,25 +399,112 @@ def _regression_gate(dense: dict, moe: dict) -> dict:
     return gate
 
 
+# Components the attribution sweep stubs one at a time ("none" is the
+# unstubbed baseline the differences are taken against).
+STUB_COMPONENTS = ("attn", "moe_ffn", "shared_expert")
+
+
+def _attribution_table(baseline_sweep: dict, stub_sweeps: dict) -> dict:
+    """Per-component decode/prefill ms/step by difference.
+
+    ``component cost = baseline ms/step − stubbed ms/step`` per phase and
+    batch size (the r5/r6 methodology, now computed by the harness
+    instead of by hand); ``residual_ms`` is what no stub accounts for
+    (embed/norms/router/glue/sampling).  Sweeps are keyed by batch size
+    as STRINGS (JSON round-trip safe — subprocess outputs arrive
+    parsed)."""
+    metrics = (("decode_ms_per_step", "decode"),
+               ("prefill_ms_per_step", "prefill"))
+    components = {}
+    for stub, sweep in stub_sweeps.items():
+        row = {}
+        for bs, base_row in baseline_sweep.items():
+            if not isinstance(base_row, dict) or bs not in sweep:
+                continue
+            for key, phase in metrics:
+                if key in base_row and key in sweep[bs]:
+                    row[f"{phase}_bs{bs}_ms"] = round(
+                        base_row[key] - sweep[bs][key], 2)
+        components[stub] = row
+    residual = {}
+    for bs, base_row in baseline_sweep.items():
+        if not isinstance(base_row, dict):
+            continue
+        for key, phase in metrics:
+            if key not in base_row:
+                continue
+            cell = f"{phase}_bs{bs}_ms"
+            attributed = sum(c.get(cell, 0.0) for c in components.values())
+            residual[cell] = round(base_row[key] - attributed, 2)
+    return {"components": components, "residual_ms": residual}
+
+
+def _run_attribution() -> dict:
+    """Run the full stub sweep, each run in a FRESH subprocess (a stub
+    changes the compiled program; sharing a process would mix compile
+    caches and XLA live buffers across variants), and emit the completed
+    per-component table."""
+    import subprocess
+    import sys
+
+    def run_one(stub: str) -> dict:
+        cmd = [sys.executable, __file__, "--stub", stub]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"attribution run --stub {stub} failed "
+                f"(rc={proc.returncode}): {proc.stderr[-2000:]}")
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)["extras"]["moe_sweep"]
+            except (json.JSONDecodeError, KeyError, TypeError):
+                continue   # TypeError: a line holding non-dict JSON
+        raise RuntimeError(
+            f"attribution run --stub {stub} printed no result JSON")
+
+    baseline = run_one("none")
+    stub_sweeps = {s: run_one(s) for s in STUB_COMPONENTS}
+    return {
+        "baseline_sweep": baseline,
+        "stub_sweeps": stub_sweeps,
+        "attribution": _attribution_table(baseline, stub_sweeps),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="one batch size per model (dev loop)")
     ap.add_argument("--gate-repeats", type=int, default=5,
-                    help="median-of-N runs for the two gated headline "
+                    help="median-of-N runs for the gated headline "
                          "numbers (>=5 for the band to mean anything)")
-    ap.add_argument("--stub", choices=["attn", "moe_ffn", "shared_expert"],
+    ap.add_argument("--stub",
+                    choices=["none", *STUB_COMPONENTS],
                     help="attribution mode: run ONLY the MoE model with "
                          "this component stubbed out of the compiled "
-                         "program (fresh process per stub; compare "
-                         "ms/step against an unstubbed run) — covers "
-                         "prefill AND decode")
+                         "program ('none' = unstubbed baseline at the "
+                         "same sizes; compare ms/step against it) — "
+                         "covers prefill AND decode")
+    ap.add_argument("--attribution", action="store_true",
+                    help="run the FULL stub sweep (none + each "
+                         "component), one fresh subprocess per run, and "
+                         "print the completed per-component decode/"
+                         "prefill ms/step table as one JSON line")
     args = ap.parse_args()
+
+    if args.attribution:
+        print(json.dumps({
+            "metric": "attribution",
+            "unit": "ms/step",
+            "extras": _run_attribution(),
+        }))
+        return
 
     if args.stub:
         sizes = [64, 256]
+        stub = () if args.stub == "none" else (args.stub,)
         moe = bench_model("deepseek-v3-bench", sizes, quantization="int8",
-                          stub=(args.stub,))
+                          stub=stub)
         print(json.dumps({
             "metric": "attribution_stub",
             "stub": args.stub,
@@ -407,8 +519,10 @@ def main() -> None:
     # prints medians-of-1; only full runs are quotable).
     n = 1 if args.quick else max(1, args.gate_repeats)
 
+    # bs64 repeats feed the prefill gate metric's band; bs256 the decode
+    # headline's.
     moe = bench_model("deepseek-v3-bench", moe_sizes, quantization="int8",
-                      repeats={256: n})
+                      repeats={256: n, 64: n})
     dense = bench_model("llama3-1b", dense_sizes, repeats={64: n})
 
     best_bs = max(moe_sizes, key=lambda b: moe[b]["decode_tok_s"])

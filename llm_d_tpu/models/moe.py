@@ -198,9 +198,13 @@ def forward(
         if quant_stacked is not None:
             # int8 payloads travel to the op STACKED (closure, not scan
             # xs — a scan slice feeding pallas_call would materialize a
-            # per-layer copy) with the MoE-layer plane index; the TPU
-            # dense path streams them through the Pallas kernel without
-            # a materialized dequant (ops/pallas/moe_int8.py).
+            # per-layer copy) with the MoE-layer plane index; on TPU
+            # they reach the Pallas int8 kernel family without a
+            # materialized dequant — dense streaming / fused-routing
+            # routed / chunk-streamed by batch regime on one device
+            # (ops/pallas/moe_int8.py, moe_routed.py,
+            # moe_routed_stream.py), and the chunk-streamed kernel per
+            # dispatch chunk on the a2a EP mesh path.
             quant = dict(quant_stacked, layer=li - Ld)
             w_gate = w_up = w_down = None
         else:

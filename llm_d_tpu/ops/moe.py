@@ -9,8 +9,9 @@ wide-ep decode.yaml:76-132).  Design:
   - Grouped GEMM: tokens are sorted by expert id and fed to
     ``jax.lax.ragged_dot`` — one MXU-friendly kernel over all local experts
     instead of a Python loop (the DeepGEMM role).  The int8 path has its
-    own three-kernel family (dense streaming / fused-routing routed /
-    sorted grouped — see ``DENSE_INT8_MAX_T`` and ``ops.pallas``).
+    own four-kernel family (dense streaming / fused-routing routed /
+    chunk-streamed routed / sorted grouped — see ``DENSE_INT8_MAX_T``
+    and ``ops.pallas``).
   - Expert parallelism: experts shard over the *flattened* (dp, sp, tp) mesh
     axes ("TPxDP in attention, EP in MoE layers", decode.yaml:76,87).  Two
     dispatch strategies:
@@ -203,29 +204,56 @@ def _dense_expert_ffn(
 # single shard (measured crossover on v5e; see _dense_expert_ffn).
 DENSE_DISPATCH_MAX_T = 512
 
-# int8 kernel routing, three regimes (r6 retune — see
-# ops/pallas/moe_routed.py and docs/perf-notes-r6.md):
+# int8 kernel routing, three regimes over a four-kernel family (r7
+# retune — see ops/pallas/moe_routed{,_stream}.py, docs/perf-notes-r7.md
+# and scripts/kernel_bench.py for the measured crossover sweep):
 #
 #   T <= DENSE_INT8_MAX_T           dense all-experts streaming kernel.
 #     Weight-bound tiny batches: all-experts compute rides under the
 #     weight-stream time anyway, and the routed kernel's per-tile
 #     padding (up to E*rt/2 phantom rows) is at its relative worst.
 #   DENSE < T <= GROUPED_INT8_MIN_T fused-routing routed kernel.
-#     The decode sweet spot: x stays VMEM-resident, gather/combine run
-#     as one-hot matmuls inside the kernel, compute is T*k rows.
-#   T >  GROUPED_INT8_MIN_T         sorted+padded grouped kernel.
-#     Prefill: x no longer fits VMEM whole (T=8192 is 32 MB bf16), the
-#     XLA sort/pad glue amortizes over big tiles (measured 2.2x over
-#     dense at T=8192, r5).
+#     The decode sweet spot: x stays VMEM-resident whole, gather/combine
+#     run as one-hot matmuls inside the kernel, compute is T*k rows.
+#   T >  GROUPED_INT8_MIN_T         chunk-streamed routed kernel
+#     (prefill default): x streams through VMEM in token-order chunks of
+#     LLMD_MOE_PREFILL_CHUNK_T rows (double-buffered), per-chunk
+#     counting-sort metadata rides scalar prefetch, and gather/combine
+#     stay in-kernel one-hot matmuls — the sorted+padded [S_pad, H] HBM
+#     layout and its 4-extra-row-trips/5x-padding glue tax are gone from
+#     the T > 512 regime entirely.  The sorted+padded grouped kernel
+#     (the r5/r6 prefill path) remains as the LLMD_MOE_PREFILL_KERNEL=
+#     grouped fallback / A-B lever.
 #
-# r5 measured the OLD two-way crossover at 256 because the grouped
-# kernel's XLA row glue ate the FLOP win at decode sizes; the routed
-# kernel removes that glue, so the dense window shrinks to the
-# genuinely weight-bound region and the grouped takeover moves to the
-# VMEM-residency bound.  Re-measure on chip via
-# LLMD_MOE_DENSE_KERNEL_MAX_T / LLMD_MOE_GROUPED_MIN_T.
+# The r6 crossovers keep their names and defaults: the dense window is
+# the genuinely weight-bound region, and GROUPED_INT8_MIN_T still marks
+# where whole-batch VMEM residency ends — above it the STREAMED kernel
+# now takes over instead of the grouped one.  Re-measure on chip via
+# LLMD_MOE_DENSE_KERNEL_MAX_T / LLMD_MOE_GROUPED_MIN_T (invalid values
+# fall back to these defaults rather than crashing the serving path).
 DENSE_INT8_MAX_T = 64
 GROUPED_INT8_MIN_T = 512
+
+# Token-chunk height for the chunk-streamed prefill kernel
+# (LLMD_MOE_PREFILL_CHUNK_T).  The chunk trades the kernel's two taxes:
+# weight re-streaming scales with T/chunk_t passes/layer while the
+# one-hot gather/combine FLOP tax scales with 2*chunk_t/(3*I); 512 sits
+# at the VMEM budget (chunk + f32 accumulator + double-buffered weight
+# tiles) on v5e.  See docs/perf-notes-r7.md.
+PREFILL_CHUNK_T = 512
+
+
+def _env_int(name: str, default: int) -> int:
+    """Integer env knob with invalid-value fallback: a malformed value
+    (e.g. ``LLMD_MOE_GROUPED_MIN_T=banana``) must degrade to the tuned
+    default, not crash the serving path at trace time."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
 
 
 def _sorted_tile_layout(flat: jax.Array, weights_flat: jax.Array,
@@ -285,7 +313,7 @@ def _routed_int8_kernel_path(x, weights, idx, quant: dict,
         # Mean rows/expert governs the tile: small tiles bound the
         # per-expert padding (the only waste left), larger tiles feed
         # the MXU better once groups support them.
-        rt = int(os.environ.get("LLMD_MOE_ROUTED_ROW_TILE", "0")) \
+        rt = _env_int("LLMD_MOE_ROUTED_ROW_TILE", 0) \
             or (32 if S < E * 96 else 64)
     else:
         rt = row_tile
@@ -310,6 +338,69 @@ def _routed_int8_kernel_path(x, weights, idx, quant: dict,
         quant["w_down_q"], quant["w_down_s"],
         row_tile=rt, interpret=interpret)
     return out[:T].astype(x.dtype)
+
+
+def _streamed_int8_kernel_path(x, weights, idx, quant: dict,
+                               chunk_t: Optional[int] = None,
+                               row_tile: Optional[int] = None,
+                               out_dtype=None,
+                               interpret: bool = False):
+    """Metadata-only glue for the chunk-streamed kernel (prefill regime).
+
+    Like ``_routed_int8_kernel_path`` no activation row moves here — but
+    the counting sort runs PER token-order CHUNK (vmapped), so the
+    kernel can stream ``x`` chunk by chunk instead of holding it
+    VMEM-resident whole.  Routing metadata stays O(S) int32; no
+    ``[S_pad, H]`` layout is ever materialized in HBM
+    (ops/pallas/moe_routed_stream.py)."""
+    from llm_d_tpu.ops.pallas.moe_routed_stream import streamed_moe_int8
+    T, H = x.shape
+    k = idx.shape[1]
+    E = quant["w_gate_q"].shape[1]
+    if chunk_t is None:
+        chunk_t = _env_int("LLMD_MOE_PREFILL_CHUNK_T", PREFILL_CHUNK_T)
+    # bf16 sublane alignment; never a taller chunk than the (aligned)
+    # batch itself — small batches degenerate to a single chunk.
+    chunk_t = max(16, min(-(-chunk_t // 16) * 16, -(-T // 16) * 16))
+    C = -(-T // chunk_t)
+    Tp = C * chunk_t
+    S_c = chunk_t * k
+    if row_tile is None:
+        # Same auto rule as the routed kernel, on per-chunk group sizes.
+        rt = _env_int("LLMD_MOE_ROUTED_ROW_TILE", 0) \
+            or (32 if S_c < E * 96 else 64)
+    else:
+        rt = row_tile
+    x_p = x.astype(jnp.bfloat16)
+    if Tp != T:
+        # Pad tokens route to expert 0 with ZERO combine weight: they
+        # occupy sorted slots in the last chunk but contribute nothing
+        # (their x rows are zero too).
+        x_p = jnp.pad(x_p, ((0, Tp - T), (0, 0)))
+        idx = jnp.pad(idx, ((0, Tp - T), (0, 0)))
+        weights = jnp.pad(weights, ((0, Tp - T), (0, 0)))
+
+    def chunk_layout(flat, wf):
+        # Chunk-local layout: tok ids are 0..chunk_t-1 within the chunk.
+        _, _, tok_s, slot, wslot_pad, tile_expert, num_tiles = \
+            _sorted_tile_layout(flat, wf, k, E, rt)
+        tok_pad = jnp.zeros((wslot_pad.shape[0],), jnp.int32).at[slot].set(
+            tok_s)
+        return tok_pad, wslot_pad, tile_expert, num_tiles
+
+    tok_pad, wslot_pad, tile_expert, num_tiles = jax.vmap(chunk_layout)(
+        idx.reshape(C, S_c), weights.reshape(C, S_c))      # [C, ...]
+    out = streamed_moe_int8(
+        x_p, tok_pad.reshape(-1, 1), tok_pad.reshape(-1, rt),
+        wslot_pad.reshape(-1, 1), tile_expert.reshape(-1),
+        num_tiles.astype(jnp.int32), quant["layer"],
+        quant["w_gate_q"], quant["w_gate_s"],
+        quant["w_up_q"], quant["w_up_s"],
+        quant["w_down_q"], quant["w_down_s"],
+        chunk_t=chunk_t, row_tile=rt, interpret=interpret)
+    # out_dtype lets combine-in-f32 callers (the a2a exchange) skip a
+    # lossy bf16 round trip of the kernel's native f32 accumulator.
+    return out[:T].astype(out_dtype or x.dtype)
 
 
 def _grouped_int8_kernel_path(x, weights, idx, quant: dict,
@@ -442,12 +533,14 @@ def _a2a_moe_chunk(
     x_c: jax.Array,        # [Tc, H] this shard's token chunk
     w_c: jax.Array,        # [Tc, k]
     idx_c: jax.Array,      # [Tc, k] global (physical) expert ids
-    w_gate: jax.Array,     # [E_loc, H, I] local expert slice
-    w_up: jax.Array,
-    w_down: jax.Array,
+    w_gate: Optional[jax.Array],   # [E_loc, H, I] local expert slice
+    w_up: Optional[jax.Array],     #   (None when quant is given)
+    w_down: Optional[jax.Array],
     ep: int,
     my_rank: jax.Array,
     ragged: bool,
+    quant: Optional[dict] = None,  # local int8 payloads [Lm, E_loc, ...]
+    interpret: bool = False,
 ) -> jax.Array:            # [Tc, H] f32
     """One chunk of the sparse dispatch/compute/combine pipeline.
 
@@ -456,10 +549,16 @@ def _a2a_moe_chunk(
     rows land contiguously from offset ``s*S``.  ``ragged`` sends only the
     actual row counts (TPU, dynamic comm volume); the dense emulation ships
     the padded regions (CPU tests, identical math).
+
+    With ``quant`` the per-chunk GEMM runs through the chunk-streamed
+    int8 kernel on the received rows (arrival order, k=1 routing with
+    validity as the combine weight) — no sort, no ragged_dot, no
+    materialized dequant on the wide-EP path either.
     """
     Tc, H = x_c.shape
     k = idx_c.shape[1]
-    E_loc = w_gate.shape[0]
+    E_loc = (quant["w_gate_q"].shape[1] if quant is not None
+             else w_gate.shape[0])
     S = Tc * k
 
     flat = idx_c.reshape(S)
@@ -496,22 +595,36 @@ def _a2a_moe_chunk(
             jnp.zeros(ep * S, jnp.int32).at[pidx].set(eloc_s),
             AXIS_EP, split_axis=0, concat_axis=0, tiled=True)
 
-    # Grouped GEMM over received rows (invalid region tails -> trash group).
+    # Expert FFN over received rows (invalid region tails contribute 0).
     rows = ep * S
     region = jnp.arange(rows, dtype=jnp.int32) // S
     valid = (jnp.arange(rows, dtype=jnp.int32) % S) < recv_sizes[region]
-    e_key = jnp.where(valid, recv_e, E_loc)
-    order2, _, _ = _stable_argsort_bounded(e_key, E_loc + 1)
-    xs = recv_x[order2]
-    counts_e = jnp.zeros(E_loc, jnp.int32).at[
-        jnp.where(valid, recv_e, 0)].add(valid.astype(jnp.int32))
-    group_sizes = jnp.concatenate([counts_e, (rows - counts_e.sum())[None]])
-    zg = jnp.zeros((1,) + w_gate.shape[1:], w_gate.dtype)
-    zd = jnp.zeros((1,) + w_down.shape[1:], w_down.dtype)
-    y = _swiglu_grouped(
-        xs, jnp.concatenate([w_gate, zg]), jnp.concatenate([w_up, zg]),
-        jnp.concatenate([w_down, zd]), group_sizes)          # [rows, H] f32
-    y = jnp.zeros((rows, H), jnp.float32).at[order2].set(y)  # arrival order
+    if quant is not None:
+        # Chunk-streamed int8 kernel on the arrival-order rows: each row
+        # is its own "token" (k=1) routed to its local expert, with the
+        # validity mask as the combine weight — invalid tails select
+        # expert 0 but multiply by 0.  Output lands in arrival order
+        # directly; the un-sort scatter below disappears.
+        y = _streamed_int8_kernel_path(
+            recv_x, valid.astype(jnp.float32)[:, None],
+            jnp.where(valid, recv_e, 0)[:, None], quant,
+            out_dtype=jnp.float32, interpret=interpret)
+    else:
+        # Grouped GEMM (bf16): sort by expert, trash group for tails.
+        e_key = jnp.where(valid, recv_e, E_loc)
+        order2, _, _ = _stable_argsort_bounded(e_key, E_loc + 1)
+        xs = recv_x[order2]
+        counts_e = jnp.zeros(E_loc, jnp.int32).at[
+            jnp.where(valid, recv_e, 0)].add(valid.astype(jnp.int32))
+        group_sizes = jnp.concatenate([counts_e,
+                                       (rows - counts_e.sum())[None]])
+        zg = jnp.zeros((1,) + w_gate.shape[1:], w_gate.dtype)
+        zd = jnp.zeros((1,) + w_down.shape[1:], w_down.dtype)
+        y = _swiglu_grouped(
+            xs, jnp.concatenate([w_gate, zg]), jnp.concatenate([w_up, zg]),
+            jnp.concatenate([w_down, zd]), group_sizes)      # [rows, H] f32
+        y = jnp.zeros((rows, H), jnp.float32).at[order2].set(
+            y)                                               # arrival order
 
     # Combine: results travel back by the exact reverse exchange; weights
     # are applied at the origin (they never cross the wire).
@@ -535,25 +648,31 @@ def _a2a_moe_chunk(
 
 def expert_ffn_a2a(
     x: jax.Array, weights: jax.Array, idx: jax.Array,
-    w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array,
+    w_gate: Optional[jax.Array], w_up: Optional[jax.Array],
+    w_down: Optional[jax.Array],
     mesh: Mesh,
     chunk_tokens: Optional[int] = None,
     dbo_min_tokens: Optional[int] = None,
+    quant: Optional[dict] = None,   # int8 payloads (w_* may be None then)
+    interpret: bool = False,        # tests: run the int8 kernel interpreted
 ) -> jax.Array:
     """Sparse all-to-all EP dispatch (the DeepEP role; see module docstring).
 
     Tokens split over the EP shards (in_specs slice the replicated batch);
     each (token, choice) row visits only its expert's shard.  Requires
     ``T % ep == 0`` and ``E % ep == 0`` — callers fall back to ``psum``
-    otherwise.
+    otherwise.  With ``quant`` the stacked int8 payloads shard over the
+    expert dim and each shard's per-chunk GEMM runs the chunk-streamed
+    kernel (``_a2a_moe_chunk``) — the prefill-regime win carries to
+    wide EP.
     """
     ep = mesh.devices.size
-    E = w_gate.shape[0]
+    E = quant["w_gate_q"].shape[1] if quant is not None else w_gate.shape[0]
     T = x.shape[0]
     assert T % ep == 0 and E % ep == 0
     T_loc = T // ep
     if chunk_tokens is None:
-        chunk_tokens = int(os.environ.get("LLMD_MOE_DP_CHUNK_SIZE", "1024"))
+        chunk_tokens = _env_int("LLMD_MOE_DP_CHUNK_SIZE", 1024)
     # DBO (the reference's --enable-dbo, decode.yaml:78,98-99): when the
     # BATCH reaches the token threshold, force at least TWO dispatch chunks.
     # Chunks are data-independent, so XLA's async collectives overlap chunk
@@ -572,7 +691,7 @@ def expert_ffn_a2a(
     # engine configured with enable_dbo=False must not inherit env state).
     if dbo_min_tokens is None \
             and os.environ.get("LLMD_MOE_DBO", "0") == "1":
-        dbo_min_tokens = int(os.environ.get("LLMD_DBO_TOKEN_THRESHOLD", "32"))
+        dbo_min_tokens = _env_int("LLMD_DBO_TOKEN_THRESHOLD", 32)
     if dbo_min_tokens is not None and dbo_min_tokens >= 0 \
             and T >= max(dbo_min_tokens, 2 * ep) and T_loc >= 2:
         chunk_tokens = min(chunk_tokens, T_loc // 2)
@@ -583,16 +702,25 @@ def expert_ffn_a2a(
     ragged = jax.default_backend() == "tpu"
     sizes = [mesh.shape[a] for a in AXIS_EP]
 
-    def shard_body(x, weights, idx, w_gate, w_up, w_down):
+    qkeys = ("w_gate_q", "w_gate_s", "w_up_q", "w_up_s",
+             "w_down_q", "w_down_s")
+
+    def shard_body(x, weights, idx, layer, *wargs):
         ep_rank = jnp.int32(0)
         for a, s in zip(AXIS_EP, sizes):
             ep_rank = ep_rank * s + jax.lax.axis_index(a)
+        if quant is not None:
+            w_gate = w_up = w_down = None
+            q_loc = dict(zip(qkeys, wargs), layer=layer)
+        else:
+            w_gate, w_up, w_down = wargs
+            q_loc = None
         outs = []
         for ci in range(n_chunks):
             sl = slice(ci * chunk_tokens, (ci + 1) * chunk_tokens)
             outs.append(_a2a_moe_chunk(
                 x[sl], weights[sl], idx[sl], w_gate, w_up, w_down,
-                ep, ep_rank, ragged))
+                ep, ep_rank, ragged, quant=q_loc, interpret=interpret))
         out = jnp.concatenate(outs) if n_chunks > 1 else outs[0]
         # Every shard needs the full hidden state back (attention and the
         # residual stream are replicated in-engine): one bf16 all-gather —
@@ -601,13 +729,22 @@ def expert_ffn_a2a(
         return jax.lax.all_gather(
             out.astype(x.dtype), AXIS_EP, axis=0, tiled=True)
 
+    if quant is not None:
+        # Stacked payloads shard over the expert dim; the layer plane
+        # index rides along replicated (it is a traced scan carry).
+        wargs = tuple(quant[k] for k in qkeys)
+        wspecs = (P(None, AXIS_EP),) * len(qkeys)
+        layer = jnp.asarray(quant["layer"], jnp.int32)
+    else:
+        wargs = (w_gate, w_up, w_down)
+        wspecs = (P(AXIS_EP),) * 3
+        layer = jnp.int32(0)
     return shard_map(
         shard_body, mesh=mesh,
-        in_specs=(P(AXIS_EP), P(AXIS_EP), P(AXIS_EP),
-                  P(AXIS_EP), P(AXIS_EP), P(AXIS_EP)),
+        in_specs=(P(AXIS_EP), P(AXIS_EP), P(AXIS_EP), P()) + wspecs,
         out_specs=P(),
         check_vma=False,
-    )(x, weights, idx, w_gate, w_up, w_down)
+    )(x, weights, idx, layer, *wargs)
 
 
 def expert_ffn(
@@ -632,12 +769,14 @@ def expert_ffn(
     docstring).
 
     ``quant`` carries int8 expert payloads END TO END: on the TPU
-    single-device dense path they reach the Pallas streaming kernel
-    WITHOUT a materialized dequant (XLA cannot fuse ``convert(int8)``
-    into a dot operand, and the int8+bf16 round trip costs ~2.5x the
-    quantized bytes — see ops/pallas/moe_int8.py); every other path
-    dequantizes here, which is numerically identical to dequantizing in
-    the model.
+    single-device path they reach the Pallas kernel family (dense
+    streaming / fused-routing routed / chunk-streamed) WITHOUT a
+    materialized dequant (XLA cannot fuse ``convert(int8)`` into a dot
+    operand, and the int8+bf16 round trip costs ~2.5x the quantized
+    bytes — see ops/pallas/moe_int8.py), and on the TPU a2a mesh path
+    they shard over the expert dim and feed the chunk-streamed kernel
+    per dispatch chunk; every other path dequantizes here, which is
+    numerically identical to dequantizing in the model.
     """
     if mesh is None or mesh.devices.size == 1:
         if dispatch == "auto":
@@ -647,10 +786,10 @@ def expert_ffn(
             # int8 kernel routing, three regimes (an EXPLICIT dispatch
             # override still gets the classic dequant paths below — the
             # A/B lever).  See the regime comment at DENSE_INT8_MAX_T.
-            dense_max = int(os.environ.get("LLMD_MOE_DENSE_KERNEL_MAX_T",
-                                           str(DENSE_INT8_MAX_T)))
-            grouped_min = int(os.environ.get("LLMD_MOE_GROUPED_MIN_T",
-                                             str(GROUPED_INT8_MIN_T)))
+            dense_max = _env_int("LLMD_MOE_DENSE_KERNEL_MAX_T",
+                                 DENSE_INT8_MAX_T)
+            grouped_min = _env_int("LLMD_MOE_GROUPED_MIN_T",
+                                   GROUPED_INT8_MIN_T)
             if x.shape[0] <= dense_max:
                 # Tiny batches: weight-bound; all-experts streaming wins.
                 return _dense_int8_kernel_path(x, weights, idx, quant)
@@ -658,12 +797,17 @@ def expert_ffn(
                 # Decode regime: fused-routing kernel, T*k rows, zero
                 # XLA row glue (ops/pallas/moe_routed.py).
                 return _routed_int8_kernel_path(x, weights, idx, quant)
-            # Prefill regime: sorted+padded grouped kernel (x too big to
-            # sit VMEM-resident; glue amortizes over big tiles).
-            return _grouped_int8_kernel_path(x, weights, idx, quant)
+            if os.environ.get("LLMD_MOE_PREFILL_KERNEL",
+                              "streamed") == "grouped":
+                # Fallback / A-B lever: the r5/r6 sorted+padded grouped
+                # kernel with its XLA row glue.
+                return _grouped_int8_kernel_path(x, weights, idx, quant)
+            # Prefill regime (default): chunk-streamed fused-routing
+            # kernel — x streams through VMEM, no sorted+padded
+            # [S_pad, H] layout in HBM (ops/pallas/moe_routed_stream.py).
+            return _streamed_int8_kernel_path(x, weights, idx, quant)
         if dispatch == "auto":
-            max_t = int(os.environ.get("LLMD_MOE_DENSE_MAX_T",
-                                       str(DENSE_DISPATCH_MAX_T)))
+            max_t = _env_int("LLMD_MOE_DENSE_MAX_T", DENSE_DISPATCH_MAX_T)
             dispatch = "dense" if x.shape[0] <= max_t else "ragged"
         if quant is not None:
             w_gate, w_up, w_down = _dequant_layer(quant)
@@ -673,10 +817,8 @@ def expert_ffn(
             out = _local_expert_ffn(
                 x, weights, idx, w_gate, w_up, w_down, jnp.int32(0))
         return out.astype(x.dtype)
-    if quant is not None:
-        w_gate, w_up, w_down = _dequant_layer(quant)
-
-    E = w_gate.shape[0]
+    E = (quant["w_gate_q"].shape[1] if quant is not None
+         else w_gate.shape[0])
     ep = mesh.devices.size
     E_loc = E // ep
     if dispatch == "auto":
@@ -688,9 +830,15 @@ def expert_ffn(
             f"'psum' on a {ep}-device mesh")
     if dispatch == "auto":
         dispatch = "a2a" if (x.shape[0] % ep == 0 and E % ep == 0) else "psum"
+    if quant is not None and not (dispatch == "a2a"
+                                  and jax.default_backend() == "tpu"):
+        # Only the TPU a2a path consumes int8 payloads directly (the
+        # per-chunk streamed kernel); everything else dequantizes here.
+        w_gate, w_up, w_down = _dequant_layer(quant)
+        quant = None
     if dispatch == "a2a":
         return expert_ffn_a2a(x, weights, idx, w_gate, w_up, w_down, mesh,
-                              dbo_min_tokens=dbo_min_tokens)
+                              dbo_min_tokens=dbo_min_tokens, quant=quant)
 
     sizes = [mesh.shape[a] for a in AXIS_EP]
 

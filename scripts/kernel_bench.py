@@ -1,0 +1,190 @@
+#!/usr/bin/env python3
+"""Per-kernel MoE int8 microbench: the measured crossover table.
+
+Times each member of the int8 MoE kernel family — dense all-experts
+streaming, fused-routing routed, sorted+padded grouped, chunk-streamed —
+through its ACTUAL ``ops.moe`` glue across a token-count sweep, and
+emits the measured crossover table as one JSON document.  This is how
+the ``LLMD_MOE_DENSE_KERNEL_MAX_T`` / ``LLMD_MOE_GROUPED_MIN_T`` /
+``LLMD_MOE_PREFILL_KERNEL`` defaults get re-derived on a real chip
+instead of hand-extrapolated (docs/perf-notes-r7.md).
+
+Two modes:
+
+  - default (TPU): deepseek-v3-bench expert shapes (E=64, H=2048, I=512,
+    k=8), warmed + repeated timings, ``timings_valid: true``.  Paths
+    with hard shape limits are bounded: the dense kernel's T*E compute
+    and the routed kernel's whole-batch VMEM residency cap out via
+    ``--dense-max-t`` / ``--routed-max-t``.
+  - ``--interpret`` (CPU CI): tiny shapes, every kernel runs through the
+    Pallas interpreter so tier-1 exercises the full dispatch glue of all
+    four kernels without a TPU.  Timings are emitted but flagged
+    ``timings_valid: false`` — the interpreter's constant factors mean
+    nothing; only the wiring is under test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+
+def _build_case(key, T, E, H, I, k, Lm=2, plane=1):
+    """Random routed batch + stacked int8 payloads addressing a non-zero
+    plane (exercises the scalar-prefetch layer indexing everywhere)."""
+    from llm_d_tpu.ops.quant import quantize_int8
+    ks = jax.random.split(key, 6)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jax.random.randint(ks[1], (T, k), 0, E)
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    quant = {"layer": jnp.int32(plane)}
+    for name, kk, shape in (("w_gate", ks[3], (E, H, I)),
+                            ("w_up", ks[4], (E, H, I)),
+                            ("w_down", ks[5], (E, I, H))):
+        q, s = quantize_int8(
+            jax.random.normal(kk, shape, jnp.float32) * 0.05)
+        quant[f"{name}_q"] = jnp.broadcast_to(q[None], (Lm,) + q.shape)
+        quant[f"{name}_s"] = jnp.broadcast_to(s[None], (Lm,) + s.shape)
+    return x, w, idx, quant
+
+
+def _paths(interpret: bool, streamed_chunk_t):
+    """name -> thunk-factory over (x, w, idx, quant).  Factories return
+    None when the path is inapplicable at this shape."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    def dense(x, w, idx, quant):
+        return lambda: moe_ops._dense_int8_kernel_path(
+            x, w, idx, quant, interpret=interpret)
+
+    def routed(x, w, idx, quant):
+        return lambda: moe_ops._routed_int8_kernel_path(
+            x, w, idx, quant, interpret=interpret)
+
+    def grouped(x, w, idx, quant):
+        return lambda: moe_ops._grouped_int8_kernel_path(
+            x, w, idx, quant, interpret=interpret)
+
+    def streamed(x, w, idx, quant):
+        return lambda: moe_ops._streamed_int8_kernel_path(
+            x, w, idx, quant, chunk_t=streamed_chunk_t,
+            interpret=interpret)
+
+    return {"dense": dense, "routed": routed, "grouped": grouped,
+            "streamed": streamed}
+
+
+def _time_ms(thunk, iters: int) -> float:
+    thunk().block_until_ready()            # compile + warm
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = thunk()
+    out.block_until_ready()
+    return 1000.0 * (time.perf_counter() - t0) / iters
+
+
+def _recommend(points: list) -> dict:
+    """Derive the three dispatch knobs from the per-T winners: the dense
+    window's top, the routed window's top, and the prefill kernel choice
+    (streamed vs grouped at the largest measured T where both ran)."""
+    fastest = {}
+    for p in points:
+        ms = {k: v for k, v in p["ms"].items() if v is not None}
+        if ms:
+            fastest[p["T"]] = min(ms, key=ms.get)
+    dense_max = max((t for t, w in fastest.items() if w == "dense"),
+                    default=None)
+    routed_max = max((t for t, w in fastest.items() if w == "routed"),
+                     default=None)
+    prefill = None
+    for p in sorted(points, key=lambda p: -p["T"]):
+        g, s = p["ms"].get("grouped"), p["ms"].get("streamed")
+        if g is not None and s is not None:
+            prefill = "streamed" if s <= g else "grouped"
+            break
+    return {
+        "fastest_by_T": {str(t): w for t, w in sorted(fastest.items())},
+        "LLMD_MOE_DENSE_KERNEL_MAX_T": dense_max,
+        "LLMD_MOE_GROUPED_MIN_T": routed_max,
+        "LLMD_MOE_PREFILL_KERNEL": prefill,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--interpret", action="store_true",
+                    help="tiny shapes through the Pallas interpreter "
+                         "(CPU CI: exercises every kernel's dispatch "
+                         "glue; timings not meaningful)")
+    ap.add_argument("--t-sweep", type=str, default=None,
+                    help="comma-separated token counts (default: "
+                         "64..8192 on chip, 8..64 interpreted)")
+    ap.add_argument("--iters", type=int, default=None,
+                    help="timed iterations per point (default 10, or 1 "
+                         "interpreted)")
+    ap.add_argument("--dense-max-t", type=int, default=1024,
+                    help="skip the all-experts dense kernel above this T "
+                         "(T*E compute)")
+    ap.add_argument("--routed-max-t", type=int, default=1024,
+                    help="skip the whole-batch-resident routed kernel "
+                         "above this T (VMEM residency)")
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the JSON document to this path")
+    args = ap.parse_args(argv)
+
+    if args.interpret:
+        E, H, I, k = 8, 256, 128, 2
+        sweep = [8, 16, 48, 64]
+        iters = args.iters or 1
+        streamed_chunk_t = 16    # force multi-chunk even at tiny T
+    else:
+        E, H, I, k = 64, 2048, 512, 8       # deepseek-v3-bench experts
+        sweep = [64, 128, 256, 512, 1024, 2048, 4096, 8192]
+        iters = args.iters or 10
+        streamed_chunk_t = None  # LLMD_MOE_PREFILL_CHUNK_T / default
+    if args.t_sweep:
+        sweep = [int(t) for t in args.t_sweep.split(",") if t]
+
+    paths = _paths(args.interpret, streamed_chunk_t)
+    points = []
+    for i, T in enumerate(sweep):
+        x, w, idx, quant = _build_case(jax.random.PRNGKey(i), T, E, H, I, k)
+        ms = {}
+        for name, factory in paths.items():
+            if name == "dense" and T > args.dense_max_t:
+                ms[name] = None
+                continue
+            if name == "routed" and T > args.routed_max_t:
+                ms[name] = None
+                continue
+            ms[name] = round(_time_ms(factory(x, w, idx, quant), iters), 3)
+        points.append({"T": T, "ms": ms})
+
+    doc = {
+        "backend": jax.default_backend(),
+        "interpret": args.interpret,
+        "timings_valid": not args.interpret,
+        "shapes": {"E": E, "H": H, "I": I, "k": k},
+        "iters": iters,
+        "points": points,
+        "crossover": _recommend(points),
+    }
+    text = json.dumps(doc)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,134 @@
+"""scripts/kernel_bench.py (interpret mode) + bench.py attribution table.
+
+The microbench's --interpret mode is the CI contract: every member of
+the int8 MoE kernel family (dense / routed / grouped / streamed) runs
+through its REAL ``ops.moe`` dispatch glue on the Pallas interpreter, so
+a glue regression in any kernel fails tier-1 without a TPU.  The
+attribution-table builder is pure arithmetic over bench sweeps and is
+pinned here directly.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _kernel_bench():
+    spec = importlib.util.spec_from_file_location(
+        "kernel_bench", REPO / "scripts" / "kernel_bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_kernel_bench_interpret_exercises_all_paths(tmp_path, capsys):
+    """One interpreted sweep point per kernel: all four paths produce a
+    timing (i.e. their glue traced, compiled and ran), the crossover
+    block is derived, and timings are flagged invalid."""
+    mod = _kernel_bench()
+    out = tmp_path / "kb.json"
+    rc = mod.main(["--interpret", "--t-sweep", "8,48", "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc == json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["interpret"] is True and doc["timings_valid"] is False
+    assert [p["T"] for p in doc["points"]] == [8, 48]
+    for p in doc["points"]:
+        for path in ("dense", "routed", "grouped", "streamed"):
+            assert isinstance(p["ms"][path], float) and p["ms"][path] > 0, \
+                (p, path)
+    xo = doc["crossover"]
+    assert set(xo["fastest_by_T"]) == {"8", "48"}
+    for key in ("LLMD_MOE_DENSE_KERNEL_MAX_T", "LLMD_MOE_GROUPED_MIN_T",
+                "LLMD_MOE_PREFILL_KERNEL"):
+        assert key in xo
+
+
+def test_kernel_bench_respects_path_caps(tmp_path):
+    """--dense-max-t / --routed-max-t null out the capped paths (the
+    shapes a real chip cannot run) and the recommendation still derives
+    from the remaining ones."""
+    mod = _kernel_bench()
+    out = tmp_path / "kb.json"
+    mod.main(["--interpret", "--t-sweep", "8,48", "--dense-max-t", "8",
+              "--routed-max-t", "8", "--out", str(out)])
+    doc = json.loads(out.read_text())
+    by_t = {p["T"]: p["ms"] for p in doc["points"]}
+    assert by_t[48]["dense"] is None and by_t[48]["routed"] is None
+    assert by_t[48]["grouped"] is not None
+    assert by_t[48]["streamed"] is not None
+    assert doc["crossover"]["LLMD_MOE_PREFILL_KERNEL"] in (
+        "streamed", "grouped")
+
+
+def test_attribution_table_differences_and_residual():
+    """component cost = baseline − stubbed per phase/bs; residual is the
+    unattributed remainder — computed by the harness, not by hand."""
+    import bench
+
+    baseline = {"64": {"decode_ms_per_step": 10.0,
+                       "prefill_ms_per_step": 100.0},
+                "256": {"decode_ms_per_step": 16.0,
+                        "prefill_ms_per_step": 240.0}}
+    stubs = {
+        "attn": {"64": {"decode_ms_per_step": 7.0,
+                        "prefill_ms_per_step": 60.0},
+                 "256": {"decode_ms_per_step": 11.0,
+                         "prefill_ms_per_step": 150.0}},
+        "moe_ffn": {"64": {"decode_ms_per_step": 6.0,
+                           "prefill_ms_per_step": 55.0},
+                    "256": {"decode_ms_per_step": 7.0,
+                            "prefill_ms_per_step": 130.0}},
+    }
+    table = bench._attribution_table(baseline, stubs)
+    assert table["components"]["attn"]["decode_bs64_ms"] == 3.0
+    assert table["components"]["attn"]["prefill_bs256_ms"] == 90.0
+    assert table["components"]["moe_ffn"]["prefill_bs64_ms"] == 45.0
+    # residual = baseline − sum(component costs)
+    assert table["residual_ms"]["decode_bs64_ms"] == 10.0 - (3.0 + 4.0)
+    assert table["residual_ms"]["prefill_bs256_ms"] == 240.0 - (90.0 + 110.0)
+
+
+def test_attribution_table_tolerates_missing_cells():
+    """A stub run that lost a batch size (OOM, timeout) must not crash
+    the table; the cell is just absent and the residual skips it."""
+    import bench
+
+    baseline = {"64": {"decode_ms_per_step": 10.0,
+                       "prefill_ms_per_step": 100.0}}
+    stubs = {"attn": {}}
+    table = bench._attribution_table(baseline, stubs)
+    assert table["components"]["attn"] == {}
+    assert table["residual_ms"]["decode_bs64_ms"] == 10.0
+
+
+def test_regression_gate_three_metrics_band_verdict():
+    """The gate covers dense-bs64 decode, moe-bs256 decode AND
+    moe-bs64 prefill; a metric regresses only when its whole band sits
+    below the best recorded number, and a prefill row carries its MFU."""
+    import bench
+
+    dense = {64: {"decode_tok_s": 11000.0,
+                  "decode_tok_s_band": [10800.0, 11500.0]}}
+    moe = {256: {"decode_tok_s": 16000.0,
+                 "decode_tok_s_band": [15500.0, 15900.0]},
+           64: {"prefill_tok_s": 20000.0, "prefill_mfu_pct": 21.0,
+                "prefill_tok_s_band": [19000.0, 21000.0]}}
+    gate = bench._regression_gate(dense, moe)
+    # dense: band max 11500 >= 11196.7 best -> not regressed.
+    assert gate["dense_bs64_regressed"] is False
+    # moe decode: whole band below 16060.6 -> regressed.
+    assert gate["moe_bs256_regressed"] is True
+    # prefill: median above best, band clears it, MFU rides along.
+    assert gate["moe_prefill_tok_s_bs64_regressed"] is False
+    assert gate["moe_prefill_tok_s_bs64_delta_pct"] > 0
+    assert gate["moe_prefill_tok_s_bs64_mfu_pct"] == 21.0
+    # No band (single sample) -> no verdict.
+    gate2 = bench._regression_gate(
+        {64: {"decode_tok_s": 11000.0}},
+        {256: {"decode_tok_s": 16000.0},
+         64: {"prefill_tok_s": 20000.0, "prefill_mfu_pct": 21.0}})
+    assert gate2["dense_bs64_regressed"] is None
+    assert gate2["moe_prefill_tok_s_bs64_regressed"] is None

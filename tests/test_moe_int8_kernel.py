@@ -276,29 +276,227 @@ def test_routed_kernel_eplb_physical_layout():
                                atol=8e-3)
 
 
-def test_grouped_kernel_routing_thresholds(monkeypatch):
-    """expert_ffn int8 routing, three regimes: T <= DENSE_INT8_MAX_T ->
-    dense streaming kernel; T <= GROUPED_INT8_MIN_T -> fused-routing
-    routed kernel (decode); larger T -> sorted+padded grouped kernel
-    (prefill).  TPU backend only."""
+def _assert_streamed_matches_oracle(x, w, idx, quant, deq,
+                                    chunk_t=None, rt=None):
+    from llm_d_tpu.ops import moe as moe_ops
+    got = moe_ops._streamed_int8_kernel_path(
+        x, w, idx, quant, chunk_t=chunk_t, row_tile=rt, interpret=True)
+    want = moe_ops._local_expert_ffn(x, w, idx, *deq, jnp.int32(0))
+    scale = float(jnp.max(jnp.abs(np.asarray(want)))) + 1e-9
+    np.testing.assert_allclose(np.asarray(got, np.float32) / scale,
+                               np.asarray(want, np.float32) / scale,
+                               atol=8e-3)
+
+
+@pytest.mark.parametrize("T,chunk_t,E,H,I,k,rt", [
+    (32, 16, 8, 256, 128, 2, 8),    # T an exact chunk multiple
+    (17, 16, 8, 256, 128, 2, 8),    # T = chunk + 1 (padded final chunk)
+    (15, 16, 8, 256, 128, 2, 8),    # T = chunk - 1 (single padded chunk)
+    (8, 64, 8, 256, 128, 2, 8),     # T < chunk (degenerates to routed)
+    (48, 16, 16, 256, 128, 8, 16),  # k=8: S_c >> chunk, multi-row groups
+])
+def test_streamed_kernel_matches_dequant_oracle(T, chunk_t, E, H, I, k, rt):
+    """Chunk-streamed kernel (per-chunk counting sort + in-kernel one-hot
+    gather/combine over streamed x chunks) == routed dequant oracle,
+    through the ACTUAL glue (_streamed_int8_kernel_path: chunk padding,
+    vmapped per-chunk layouts, flattened tile metadata) in interpret
+    mode, across every chunk-boundary shape class."""
+    key = jax.random.PRNGKey(23)
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jax.random.randint(ks[1], (T, k), 0, E)
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[3], E, H, I)
+    _assert_streamed_matches_oracle(x, w, idx, quant, deq,
+                                    chunk_t=chunk_t, rt=rt)
+
+
+def test_streamed_kernel_empty_experts_within_chunk():
+    """Routing concentrated on 3 of 16 experts: every CHUNK's tile map
+    references only populated experts (zero tiles for empty groups —
+    their weights are never streamed for that chunk) and trailing
+    inactive tiles repeat the last active expert so their weight DMA is
+    skipped.  Output still matches the oracle."""
     from llm_d_tpu.ops import moe as moe_ops
 
+    key = jax.random.PRNGKey(29)
+    T, chunk_t, E, H, I, k, rt = 32, 16, 16, 256, 128, 2, 16
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    hot = jnp.asarray([1, 7, 12], jnp.int32)
+    idx = hot[jax.random.randint(ks[1], (T, k), 0, 3)]
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[3], E, H, I)
+    _assert_streamed_matches_oracle(x, w, idx, quant, deq,
+                                    chunk_t=chunk_t, rt=rt)
+    S_c = chunk_t * k
+    for c in range(T // chunk_t):
+        sl = idx.reshape(-1)[c * S_c:(c + 1) * S_c]
+        wl = w.reshape(-1)[c * S_c:(c + 1) * S_c]
+        _, _, _, _, _, tile_e, num_tiles = moe_ops._sorted_tile_layout(
+            sl, wl, k, E, rt)
+        nt = int(num_tiles)
+        active = np.asarray(tile_e[:nt])
+        assert set(active.tolist()) <= {1, 7, 12}, c
+        assert np.all(np.asarray(tile_e[nt:]) == active[-1]), c
+
+
+def test_streamed_kernel_duplicate_routes_across_chunk_boundaries():
+    """Duplicate routes both WITHIN a token (both k slots -> expert 2)
+    and ACROSS chunks (every chunk routes to the same expert, whose
+    weights re-stream per chunk): contributions accumulate exactly in
+    the chunk-resident f32 output blocks."""
+    key = jax.random.PRNGKey(31)
+    T, chunk_t, E, H, I = 48, 16, 4, 256, 128
+    ks = jax.random.split(key, 3)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jnp.stack([jnp.full((T,), 2, jnp.int32),
+                     jnp.full((T,), 2, jnp.int32)], axis=1)
+    w = jnp.abs(jax.random.normal(ks[1], (T, 2), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[2], E, H, I)
+    _assert_streamed_matches_oracle(x, w, idx, quant, deq,
+                                    chunk_t=chunk_t, rt=8)
+
+
+def test_streamed_kernel_eplb_physical_layout():
+    """Streamed kernel under an EPLB replica table (mirrors the routed
+    kernel's test): logical ids map to physical slots, replicas carry
+    the same weights, and the chunked physical layout matches the
+    logical oracle."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    key = jax.random.PRNGKey(37)
+    T, chunk_t, E_log, H, I, k = 40, 16, 4, 256, 128, 2
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jax.random.randint(ks[1], (T, k), 0, E_log)
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[3], E_log, H, I)
+
+    replica_table = jnp.asarray(
+        [[0, 0], [1, 4], [2, 2], [3, 5]], jnp.int32)
+    num_replicas = jnp.asarray([1, 2, 1, 2], jnp.int32)
+    phys_of = [0, 1, 2, 3, 1, 3]
+    quant_phys = dict(quant)
+    for name in ("w_gate", "w_up", "w_down"):
+        for suf in ("_q", "_s"):
+            a = quant[name + suf]
+            quant_phys[name + suf] = a[:, jnp.asarray(phys_of)]
+    phys_idx = moe_ops.to_physical_experts(idx, replica_table, num_replicas)
+    assert int(phys_idx.max()) >= E_log  # replicas actually exercised
+
+    got = moe_ops._streamed_int8_kernel_path(
+        x, w, phys_idx, quant_phys, chunk_t=chunk_t, row_tile=8,
+        interpret=True)
+    want = moe_ops._local_expert_ffn(x, w, idx, *deq, jnp.int32(0))
+    scale = float(jnp.max(jnp.abs(np.asarray(want)))) + 1e-9
+    np.testing.assert_allclose(np.asarray(got, np.float32) / scale,
+                               np.asarray(want, np.float32) / scale,
+                               atol=8e-3)
+
+
+def test_streamed_a2a_matches_dequant_a2a(devices):
+    """Wide-EP per-chunk GEMM through the streamed int8 kernel
+    (expert_ffn_a2a with quant payloads sharded over the expert dim)
+    == the bf16 dequant a2a path — the prefill-regime win carries to
+    EP without changing the exchange wire layout."""
+    from llm_d_tpu.ops import moe as moe_ops
+    from llm_d_tpu.ops.quant import dequantize
+    from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=4, sp=1, tp=2), devices)
+    key = jax.random.PRNGKey(41)
+    T, E, H, I, k = 32, 16, 64, 32, 2
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (T, H), jnp.bfloat16)
+    idx = jax.random.randint(ks[1], (T, k), 0, E)
+    w = jnp.abs(jax.random.normal(ks[2], (T, k), jnp.float32)) * 0.3
+    quant, deq = _rand_quant(ks[3], E, H, I)
+
+    got = moe_ops.expert_ffn_a2a(x, w, idx, None, None, None, mesh,
+                                 quant=quant, interpret=True)
+    want = moe_ops.expert_ffn_a2a(x, w, idx, *deq, mesh)
+    scale = float(jnp.max(jnp.abs(np.asarray(want, np.float32)))) + 1e-9
+    np.testing.assert_allclose(np.asarray(got, np.float32) / scale,
+                               np.asarray(want, np.float32) / scale,
+                               atol=1e-2)
+
+
+def _record_dispatch(monkeypatch):
+    from llm_d_tpu.ops import moe as moe_ops
     calls = []
-    monkeypatch.setattr(moe_ops, "_dense_int8_kernel_path",
-                        lambda x, *a, **kw: calls.append("dense") or x)
-    monkeypatch.setattr(moe_ops, "_routed_int8_kernel_path",
-                        lambda x, *a, **kw: calls.append("routed") or x)
-    monkeypatch.setattr(moe_ops, "_grouped_int8_kernel_path",
-                        lambda x, *a, **kw: calls.append("grouped") or x)
+    for name in ("dense", "routed", "grouped", "streamed"):
+        monkeypatch.setattr(
+            moe_ops, f"_{name}_int8_kernel_path",
+            lambda x, *a, _n=name, **kw: calls.append(_n) or x)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    return calls
+
+
+def _dispatch(T):
+    from llm_d_tpu.ops import moe as moe_ops
     quant = dict(w_gate_q=jnp.zeros((1, 4, 8, 8), jnp.int8))
+    moe_ops.expert_ffn(jnp.ones((T, 8), jnp.bfloat16),
+                       jnp.ones((T, 2), jnp.float32),
+                       jnp.zeros((T, 2), jnp.int32),
+                       None, None, None, quant=quant)
+
+
+def test_int8_kernel_routing_thresholds(monkeypatch):
+    """expert_ffn int8 routing, three regimes: T <= DENSE_INT8_MAX_T ->
+    dense streaming kernel; T <= GROUPED_INT8_MIN_T -> fused-routing
+    routed kernel (decode); larger T -> CHUNK-STREAMED kernel (prefill
+    default; the grouped kernel is the env-selected fallback).  TPU
+    backend only."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    calls = _record_dispatch(monkeypatch)
     ts = (moe_ops.DENSE_INT8_MAX_T,          # <= lower bound -> dense
           moe_ops.DENSE_INT8_MAX_T + 1,      # decode window -> routed
           moe_ops.GROUPED_INT8_MIN_T,        # window top -> routed
-          moe_ops.GROUPED_INT8_MIN_T + 1)    # above -> grouped
+          moe_ops.GROUPED_INT8_MIN_T + 1)    # above -> streamed
     for T in ts:
-        moe_ops.expert_ffn(jnp.ones((T, 8), jnp.bfloat16),
-                           jnp.ones((T, 2), jnp.float32),
-                           jnp.zeros((T, 2), jnp.int32),
-                           None, None, None, quant=quant)
-    assert calls == ["dense", "routed", "routed", "grouped"]
+        _dispatch(T)
+    assert calls == ["dense", "routed", "routed", "streamed"]
+
+
+def test_regime_dispatch_default_sweep(monkeypatch):
+    """The ISSUE-pinned sweep: which of the (re-tuned) paths each T
+    selects under the default crossovers."""
+    calls = _record_dispatch(monkeypatch)
+    for T in (8, 64, 65, 512, 513, 8192):
+        _dispatch(T)
+    assert calls == ["dense", "dense", "routed",
+                     "routed", "streamed", "streamed"]
+
+
+def test_regime_dispatch_env_overrides(monkeypatch):
+    """Crossover env overrides move the windows; the prefill-kernel
+    selector swaps streamed for the grouped fallback."""
+    calls = _record_dispatch(monkeypatch)
+    monkeypatch.setenv("LLMD_MOE_DENSE_KERNEL_MAX_T", "4")
+    monkeypatch.setenv("LLMD_MOE_GROUPED_MIN_T", "100")
+    for T in (8, 64, 65, 512, 513, 8192):
+        _dispatch(T)
+    assert calls == ["routed", "routed", "routed",
+                     "streamed", "streamed", "streamed"]
+    calls.clear()
+    monkeypatch.setenv("LLMD_MOE_PREFILL_KERNEL", "grouped")
+    for T in (100, 512, 8192):   # window top still routed; above ->
+        _dispatch(T)             # the grouped fallback, everywhere
+    assert calls == ["routed", "grouped", "grouped"]
+
+
+def test_regime_dispatch_invalid_env_falls_back(monkeypatch):
+    """Malformed crossover values must degrade to the tuned defaults —
+    not crash the serving path at trace time."""
+    calls = _record_dispatch(monkeypatch)
+    monkeypatch.setenv("LLMD_MOE_DENSE_KERNEL_MAX_T", "banana")
+    monkeypatch.setenv("LLMD_MOE_GROUPED_MIN_T", "")
+    monkeypatch.setenv("LLMD_MOE_PREFILL_KERNEL", "warp-drive")
+    for T in (8, 64, 65, 512, 513, 8192):
+        _dispatch(T)
+    # Defaults: identical to test_regime_dispatch_default_sweep (an
+    # unknown prefill-kernel name means the streamed default).
+    assert calls == ["dense", "dense", "routed",
+                     "routed", "streamed", "streamed"]
