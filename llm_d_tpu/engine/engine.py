@@ -33,6 +33,7 @@ from llm_d_tpu.models.config import ModelConfig, get_config
 from llm_d_tpu.ops import sampling as sampling_ops
 from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
 from llm_d_tpu.parallel.sharding import logical_to_sharding, shard_pytree
+from llm_d_tpu.utils.faultinject import get_injector
 from llm_d_tpu.utils.metrics import EngineMetrics
 
 logger = logging.getLogger(__name__)
@@ -825,6 +826,11 @@ class EngineCore:
     # ---------- step ----------
 
     def step(self) -> List[RequestOutput]:
+        # Chaos fault point: simulated engine death (a raised fault
+        # propagates exactly like a real step crash — AsyncEngine marks
+        # the engine dead, fails all streams, /health turns 500).  No-op
+        # dict miss unless rules are installed.
+        get_injector().check("engine.step")
         outputs: List[RequestOutput] = []
         if self._rejected:
             outputs.extend(self._rejected)

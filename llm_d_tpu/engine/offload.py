@@ -40,6 +40,8 @@ import numpy as np
 
 from llm_d_tpu.transfer.connector import _cache_items, _gather_fn, _scatter_fn
 from llm_d_tpu.transfer import transport
+from llm_d_tpu.utils.config import env_float, env_int
+from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 
 logger = logging.getLogger(__name__)
 
@@ -95,8 +97,10 @@ class HostKVTier:
     """
 
     # A peer with this many consecutive transport failures is skipped for
-    # PEER_BACKOFF_S (a dead peer's blackholed IP would otherwise stall the
-    # engine thread peer_timeout_ms per uncached block).
+    # the backoff window (a dead peer's blackholed IP would otherwise stall
+    # the engine thread peer_timeout_ms per uncached block).  Class attrs
+    # are the shipped defaults; instances read the LLMD_PEER_FAILURE_LIMIT /
+    # LLMD_PEER_BACKOFF_S env knobs (invalid values fall back here).
     PEER_FAILURE_LIMIT = 3
     PEER_BACKOFF_S = 30.0
 
@@ -126,6 +130,10 @@ class HostKVTier:
         self.server = None
         if serve_port is not None:
             self.server = transport.PyTransferServer("0.0.0.0", serve_port)
+        self.peer_failure_limit = env_int("LLMD_PEER_FAILURE_LIMIT",
+                                          self.PEER_FAILURE_LIMIT)
+        self.peer_backoff_s = env_float("LLMD_PEER_BACKOFF_S",
+                                        self.PEER_BACKOFF_S)
         static = [p for p in (peers or [])
                   if not p.startswith(("dns:", "k8s:"))]
         specs = [p for p in (peers or []) if p.startswith(("dns:", "k8s:"))]
@@ -345,10 +353,11 @@ class HostKVTier:
         now = _time.monotonic()
         for peer in self.peers:
             fails, retry_after = self._peer_health.get(peer, (0, 0.0))
-            if fails >= self.PEER_FAILURE_LIMIT and now < retry_after:
+            if fails >= self.peer_failure_limit and now < retry_after:
                 continue                      # dead peer in backoff
             host, _, port = peer.rpartition(":")
             try:
+                get_injector().check("kv.peer_fetch", key=peer)
                 blob = transport.fetch(host, int(port), key,
                                        timeout_ms=self.peer_timeout_ms)
                 _unpack_block_slab(blob, names, L, bs)   # validate layout
@@ -356,7 +365,8 @@ class HostKVTier:
                 # Peer alive, block absent: a healthy miss.
                 self._peer_health.pop(peer, None)
                 continue
-            except (transport.TransferError, ValueError, OSError) as exc:
+            except (transport.TransferError, ValueError, OSError,
+                    FaultInjected) as exc:
                 # Transport-level unreachability (refused / no route /
                 # timed out) means the PEER is down, not this block: trip
                 # straight into backoff so a dead peer costs ONE timeout
@@ -368,11 +378,11 @@ class HostKVTier:
                 conn_err = conn_err or isinstance(exc, TimeoutError) \
                     or "timed out" in str(exc).lower() \
                     or "refused" in str(exc).lower()
-                fails = self.PEER_FAILURE_LIMIT if conn_err else fails + 1
+                fails = self.peer_failure_limit if conn_err else fails + 1
                 self._peer_health[peer] = (
-                    fails, _time.monotonic() + self.PEER_BACKOFF_S)
+                    fails, _time.monotonic() + self.peer_backoff_s)
                 log = (logger.warning
-                       if fails >= self.PEER_FAILURE_LIMIT else logger.debug)
+                       if fails >= self.peer_failure_limit else logger.debug)
                 log("shared-tier peer %s failed (%s): %s", peer,
                     "unreachable, backing off" if conn_err
                     else f"{fails} consecutive", exc)

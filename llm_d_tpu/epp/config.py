@@ -111,6 +111,7 @@ apiVersion: inference.networking.x-k8s.io/v1alpha1
 kind: EndpointPickerConfig
 plugins:
 - type: single-profile-handler
+- type: circuit-breaker-filter
 - type: queue-scorer
 - type: kv-cache-utilization-scorer
 - type: prefix-cache-scorer
@@ -121,6 +122,7 @@ plugins:
 schedulingProfiles:
 - name: default
   plugins:
+  - pluginRef: circuit-breaker-filter
   - pluginRef: queue-scorer
     weight: 2
   - pluginRef: kv-cache-utilization-scorer

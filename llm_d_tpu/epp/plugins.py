@@ -44,6 +44,14 @@ class RequestCtx:
     priority: int = 0
     shed: bool = False
     predictions: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # Retry-on-alternate-endpoint: addresses whose forward already failed
+    # for THIS request; the scheduler drops them from every candidate set
+    # so the retry re-runs the full pipeline over the remaining replicas.
+    excluded_endpoints: set = dataclasses.field(default_factory=set)
+    # 0 on the first schedule of a request, 1.. on gateway retries — the
+    # scheduler counts a REQUEST (requests_total) only on attempt 0 so
+    # retry storms don't inflate traffic dashboards mid-incident.
+    retry_attempt: int = 0
 
     @classmethod
     def from_request(cls, body: Dict[str, Any],
@@ -128,6 +136,30 @@ class DecodeFilter(Plugin):
 
     def filter(self, ctx, candidates):
         return [e for e in candidates if e.role in ("decode", "both")]
+
+
+class CircuitBreakerFilter(Plugin):
+    """Drop endpoints whose request-level circuit breaker is open
+    (``datastore.breaker``; see ``EndpointBreaker``): a replica whose
+    requests are failing must stop winning picks even while its scrape
+    still looks healthy.
+
+    Fail-open: when EVERY candidate is tripped the original set passes
+    through — a full outage must keep probing and heal through half-open,
+    not turn into a permanent 503 after the pods recover."""
+
+    def filter(self, ctx, candidates):
+        breaker = getattr(self.datastore, "breaker", None)
+        if breaker is None:
+            return candidates
+        allowed = [e for e in candidates if breaker.admissible(e.address)]
+        return allowed or candidates
+
+    def on_picked(self, ctx, endpoint, profile):
+        breaker = getattr(self.datastore, "breaker", None)
+        if breaker is not None:
+            # Arms the half-open probe window (no-op for closed breakers).
+            breaker.note_pick(endpoint.address)
 
 
 # ---------- scorers ----------
@@ -508,6 +540,7 @@ class PrefillHeaderHandler(Plugin):
 PLUGIN_TYPES = {
     "prefill-filter": PrefillFilter,
     "decode-filter": DecodeFilter,
+    "circuit-breaker-filter": CircuitBreakerFilter,
     "queue-scorer": QueueScorer,
     "kv-cache-utilization-scorer": KvCacheUtilizationScorer,
     "prefix-cache-scorer": PrefixCacheScorer,

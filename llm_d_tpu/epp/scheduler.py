@@ -23,6 +23,7 @@ from llm_d_tpu.epp.plugins import (
     PdProfileHandler,
     Plugin,
     PrecisePrefixCacheScorer,
+    PrefillHeaderHandler,
     RequestCtx,
     SingleProfileHandler,
     SloAwareProfileHandler,
@@ -103,6 +104,7 @@ class EppScheduler:
                 picks[pname] = chosen
                 for plugin in self.plugins.values():
                     plugin.on_picked(ctx, chosen, pname)
+                self._append_prefill_alternates(ctx, pname, chosen, scores)
 
         headers = dict(ctx.headers)
         result = SchedulingResult(picks=picks, headers=headers,
@@ -110,13 +112,37 @@ class EppScheduler:
         primary = result.primary
         if primary is not None:
             result.headers[DESTINATION_HEADER] = primary.address
-            self.metrics.requests_total.labels(target=primary.address).inc()
+            if ctx.retry_attempt == 0:
+                self.metrics.requests_total.labels(
+                    target=primary.address).inc()
         self.metrics.scheduling_duration.observe(time.perf_counter() - t0)
         return result
 
+    # Runner-up prefillers appended to the hint header (sidecar failover).
+    PREFILL_ALTERNATES = 2
+
+    def _append_prefill_alternates(self, ctx: RequestCtx, pname: str,
+                                   chosen, scores: Dict[str, float]) -> None:
+        """Extend ``x-prefiller-host-port`` with up to PREFILL_ALTERNATES
+        runners-up (score order) so the sidecar can fail over to the next
+        prefiller without a gateway round trip (P/D-Serve: per-request
+        failover at the routing layer, not pod restart).  A single-
+        prefiller pool leaves the header as the bare winner — the wire
+        format only grows when there IS an alternate."""
+        if pname != "prefill":
+            return
+        header = PrefillHeaderHandler.HEADER
+        if ctx.headers.get(header) != chosen.address:
+            return                     # no prefill-header-handler configured
+        alts = sorted((a for a in scores if a != chosen.address),
+                      key=lambda a: -scores[a])[:self.PREFILL_ALTERNATES]
+        if alts:
+            ctx.headers[header] = ",".join([chosen.address] + alts)
+
     def _run_profile(self, ctx: RequestCtx, profile):
         role = {"prefill": "prefill", "decode": "decode"}.get(profile.name)
-        candidates = [e for e in self.datastore.candidates(role) if e.ready]
+        candidates = [e for e in self.datastore.candidates(role)
+                      if e.ready and e.address not in ctx.excluded_endpoints]
         totals: Dict[str, float] = {e.address: 0.0 for e in candidates}
         picker: Optional[Plugin] = None
         picker_ref = None

@@ -30,13 +30,20 @@ import aiohttp
 from aiohttp import web
 
 from llm_d_tpu.epp.config import DEFAULT_CONFIG_YAML, parse_config
-from llm_d_tpu.epp.datastore import Datastore, EndpointState
+from llm_d_tpu.epp.datastore import Datastore, EndpointBreaker, EndpointState
 from llm_d_tpu.epp.indexer import PrefixIndex, ZmqEventSubscriber
 from llm_d_tpu.epp.plugins import RequestCtx
 from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
+from llm_d_tpu.utils.config import env_int
+from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 from llm_d_tpu.utils.metrics import EppMetrics
 
 logger = logging.getLogger(__name__)
+
+# Retry observability: the attempt index rides to the upstream (log
+# correlation) and the spent/total budget rides back to the client.
+RETRY_ATTEMPT_HEADER = "x-llmd-retry-attempt"
+RETRY_BUDGET_HEADER = "x-llmd-retry-budget"
 
 
 def parse_endpoint_arg(arg: str) -> EndpointState:
@@ -100,11 +107,16 @@ class FlowControl:
 class Gateway:
     def __init__(self, scheduler: EppScheduler, datastore: Datastore,
                  subscriber: Optional[ZmqEventSubscriber] = None,
-                 flow: Optional[FlowControl] = None) -> None:
+                 flow: Optional[FlowControl] = None,
+                 retry_attempts: Optional[int] = None) -> None:
         self.scheduler = scheduler
         self.datastore = datastore
         self.subscriber = subscriber
         self.flow = flow
+        # Retries on an ALTERNATE endpoint after connect-failure/5xx
+        # (P/D-Serve: routing-layer retry preserves goodput; 0 disables).
+        self.retry_attempts = (retry_attempts if retry_attempts is not None
+                               else env_int("LLMD_GATEWAY_RETRIES", 2))
         self._session: Optional[aiohttp.ClientSession] = None
 
     def build_app(self) -> web.Application:
@@ -186,63 +198,139 @@ class Gateway:
     async def _schedule_and_forward(self, body: Dict,
                                     request: web.Request
                                     ) -> web.StreamResponse:
-        try:
-            ctx = self._make_ctx(body, request)
-            # Scoring may block (prediction-sidecar HTTP, lock contention):
-            # keep it off the event loop so streaming relays never stall.
-            result = await asyncio.to_thread(self.scheduler.schedule, ctx)
-        except (TypeError, ValueError) as exc:
-            return web.json_response(
-                {"error": f"invalid request: {exc}"}, status=400)
-        if ctx.shed:
-            # No pod can meet the SLOs and the request is sheddable
-            # (priority < 0): refuse instead of queueing it in the
-            # negative bucket (reference: README.md:190-192).
-            self.scheduler.metrics.shed_total.inc()
-            return web.json_response(
-                {"error": "shed: no endpoint meets the requested SLOs"},
-                status=429)
-        primary = result.primary
-        if primary is None:
-            return web.json_response(
-                {"error": "no ready endpoints"}, status=503)
-        if ctx.predictions:
-            # Ride the predictions to the model server so its usage frame
-            # can report predicted vs actual (reference SSE usage contract,
-            # README.md:130-148).
-            body = dict(body)
-            body["_predicted"] = ctx.predictions
+        """Schedule, forward, and on connect-failure/5xx RE-SCHEDULE on the
+        surviving replicas (bounded attempts; failed endpoints are excluded
+        from the retry's candidate set and recorded against their circuit
+        breaker).  Only failures with NO response bytes committed retry —
+        a half-sent stream can't be replayed."""
+        breaker = self.datastore.breaker
+        metrics = self.scheduler.metrics
+        max_attempts = 1 + max(0, self.retry_attempts)
+        excluded: set = set()
+        rid = ""
+        last_error = "no ready endpoints"
+        attempts_made = 0          # forwards actually sent (error reporting)
 
-        # PD: hand the sidecar its prefill hint via the request headers.
-        fwd_headers = {k: v for k, v in result.headers.items()
-                       if k != DESTINATION_HEADER}
-        url = f"{primary.url}{request.path}"
-        resp = None
-        try:
-            # No total timeout: it would count SSE streaming time and sever
-            # long generations mid-stream; connect failures surface fast.
-            async with self._session.post(
-                    url, json=body, headers=fwd_headers,
-                    timeout=aiohttp.ClientTimeout(
-                        total=None, sock_connect=10)) as upstream:
-                resp = web.StreamResponse(status=upstream.status)
-                for k in ("Content-Type",):
-                    if k in upstream.headers:
-                        resp.headers[k] = upstream.headers[k]
-                resp.headers[DESTINATION_HEADER] = primary.address
-                await resp.prepare(request)
-                async for chunk in upstream.content.iter_any():
-                    await resp.write(chunk)
-                await resp.write_eof()
-                return resp
-        except aiohttp.ClientError as exc:
-            if resp is not None:
-                # Headers already went out: a second (json) response would
-                # corrupt the half-sent stream — close it truncated.
-                return resp
+        def note_retry(addr: str, reason: str, error: str) -> None:
+            """Shared retry bookkeeping: breaker, exclusion, metric, log."""
+            nonlocal last_error
+            breaker.record_failure(addr)
+            excluded.add(addr)
+            last_error = error
+            metrics.gateway_retries.labels(reason=reason).inc()
+            logger.warning(
+                "retrying request %s on alternate endpoint "
+                "(attempt %d/%d): %s", rid or "-", attempts_made,
+                max_attempts, error)
+
+        def has_alternate(addr: str) -> bool:
+            return any(e.ready and e.address not in excluded
+                       and e.address != addr
+                       for e in self.datastore.candidates())
+        for attempt in range(max_attempts):
+            try:
+                ctx = self._make_ctx(body, request)
+                ctx.excluded_endpoints = set(excluded)
+                ctx.retry_attempt = attempt
+                rid = ctx.request_id
+                # Scoring may block (prediction-sidecar HTTP, lock
+                # contention): keep it off the event loop so streaming
+                # relays never stall.
+                result = await asyncio.to_thread(self.scheduler.schedule, ctx)
+            except (TypeError, ValueError) as exc:
+                return web.json_response(
+                    {"error": f"invalid request: {exc}",
+                     "request_id": rid}, status=400)
+            if ctx.shed:
+                # No pod can meet the SLOs and the request is sheddable
+                # (priority < 0): refuse instead of queueing it in the
+                # negative bucket (reference: README.md:190-192).
+                metrics.shed_total.inc()
+                return web.json_response(
+                    {"error": "shed: no endpoint meets the requested SLOs",
+                     "request_id": rid}, status=429)
+            primary = result.primary
+            if primary is None:
+                # First attempt: genuinely nothing ready.  On a retry:
+                # every surviving candidate is excluded — stop early.
+                break
+            fwd_body = body
+            if ctx.predictions:
+                # Ride the predictions to the model server so its usage
+                # frame can report predicted vs actual (reference SSE usage
+                # contract, README.md:130-148).
+                fwd_body = dict(body)
+                fwd_body["_predicted"] = ctx.predictions
+
+            # PD: hand the sidecar its prefill hint via the request headers.
+            fwd_headers = {k: v for k, v in result.headers.items()
+                           if k != DESTINATION_HEADER}
+            fwd_headers[RETRY_ATTEMPT_HEADER] = str(attempt)
+            url = f"{primary.url}{request.path}"
+            resp = None
+            attempts_made += 1
+            try:
+                await get_injector().acheck("gateway.forward",
+                                            key=primary.address)
+                # No total timeout: it would count SSE streaming time and
+                # sever long generations mid-stream; connect failures
+                # surface fast.
+                async with self._session.post(
+                        url, json=fwd_body, headers=fwd_headers,
+                        timeout=aiohttp.ClientTimeout(
+                            total=None, sock_connect=10)) as upstream:
+                    if upstream.status >= 500 \
+                            and attempt + 1 < max_attempts \
+                            and has_alternate(primary.address):
+                        # Replica-side failure with nothing committed yet
+                        # AND somewhere else to go: burn a retry on an
+                        # alternate instead of relaying.  With no
+                        # alternate (single-replica pool, everything else
+                        # excluded) the upstream's own status and
+                        # diagnostic body relay verbatim below.
+                        note_retry(primary.address, "5xx",
+                                   f"upstream {primary.address} "
+                                   f"HTTP {upstream.status}")
+                        continue
+                    if upstream.status >= 500:
+                        breaker.record_failure(primary.address)
+                    else:
+                        breaker.record_success(primary.address)
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k in ("Content-Type",):
+                        if k in upstream.headers:
+                            resp.headers[k] = upstream.headers[k]
+                    resp.headers[DESTINATION_HEADER] = primary.address
+                    resp.headers[RETRY_BUDGET_HEADER] = \
+                        f"{attempt}/{max_attempts - 1}"
+                    await resp.prepare(request)
+                    async for chunk in upstream.content.iter_any():
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                    return resp
+            except (aiohttp.ClientError, FaultInjected) as exc:
+                if resp is not None:
+                    # Headers already went out: a second (json) response
+                    # would corrupt the half-sent stream — close it
+                    # truncated (and count the endpoint's failure).
+                    breaker.record_failure(primary.address)
+                    return resp
+                if attempt + 1 < max_attempts:
+                    note_retry(primary.address, "connect",
+                               f"upstream {primary.address} failed: {exc}")
+                    continue
+                breaker.record_failure(primary.address)
+                excluded.add(primary.address)
+                last_error = f"upstream {primary.address} failed: {exc}"
+        if excluded:
+            metrics.gateway_retry_exhausted.inc()
+            logger.error("request %s failed after %d attempt(s): %s",
+                         rid or "-", attempts_made, last_error)
             return web.json_response(
-                {"error": f"upstream {primary.address} failed: {exc}"},
-                status=502)
+                {"error": last_error, "request_id": rid,
+                 "attempts": attempts_made}, status=502)
+        return web.json_response(
+            {"error": "no ready endpoints", "request_id": rid}, status=503)
 
     def _make_ctx(self, body: Dict, request: web.Request) -> RequestCtx:
         return RequestCtx.from_request(
@@ -260,12 +348,19 @@ def build_gateway(
     max_inflight: int = 256,
     max_queue: int = 128,
     queue_timeout_s: float = 30.0,
+    retry_attempts: Optional[int] = None,
+    breaker: Optional[EndpointBreaker] = None,
 ) -> Gateway:
     config = parse_config(config_yaml or DEFAULT_CONFIG_YAML)
+    metrics = EppMetrics()
+    if breaker is None:
+        breaker = EndpointBreaker(metrics=metrics)
+    elif breaker.metrics is None:
+        breaker.metrics = metrics
     datastore = Datastore(endpoints, scrape_interval_s=scrape_interval_s,
                           resolver=resolver,
-                          resolve_interval_s=resolve_interval_s)
-    metrics = EppMetrics()
+                          resolve_interval_s=resolve_interval_s,
+                          breaker=breaker)
     needs_index = any(p.type == "precise-prefix-cache-scorer"
                       for p in config.plugins)
     subscriber = None
@@ -280,7 +375,8 @@ def build_gateway(
                              indexer=indexer)
     flow = (FlowControl(max_inflight, max_queue, queue_timeout_s, metrics)
             if max_inflight > 0 else None)
-    return Gateway(scheduler, datastore, subscriber=subscriber, flow=flow)
+    return Gateway(scheduler, datastore, subscriber=subscriber, flow=flow,
+                   retry_attempts=retry_attempts)
 
 
 def main(argv: Optional[List[str]] = None) -> None:
@@ -315,6 +411,18 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="flow control: waiting-queue depth before 503")
     p.add_argument("--queue-timeout", type=float, default=30.0,
                    help="flow control: max seconds a request may queue")
+    p.add_argument("--retry-attempts", type=int, default=None,
+                   help="retries on an alternate endpoint after connect "
+                        "failure/5xx (default LLMD_GATEWAY_RETRIES or 2; "
+                        "0 disables)")
+    p.add_argument("--breaker-failures", type=int, default=None,
+                   help="consecutive request failures that trip an "
+                        "endpoint's circuit breaker (default "
+                        "LLMD_BREAKER_FAILURES or 3)")
+    p.add_argument("--breaker-open-s", type=float, default=None,
+                   help="seconds a tripped breaker stays open before "
+                        "half-open probing (default LLMD_BREAKER_OPEN_S "
+                        "or 5)")
     args = p.parse_args(argv)
 
     config_yaml = None
@@ -339,7 +447,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                        resolve_interval_s=args.resolve_interval,
                        max_inflight=args.max_inflight,
                        max_queue=args.max_queue,
-                       queue_timeout_s=args.queue_timeout)
+                       queue_timeout_s=args.queue_timeout,
+                       retry_attempts=args.retry_attempts,
+                       breaker=EndpointBreaker(
+                           failure_threshold=args.breaker_failures,
+                           open_s=args.breaker_open_s))
     logging.basicConfig(level=logging.INFO)
     ext_server = None
     if args.ext_proc_port is not None:

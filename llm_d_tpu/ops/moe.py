@@ -246,14 +246,10 @@ PREFILL_CHUNK_T = 512
 def _env_int(name: str, default: int) -> int:
     """Integer env knob with invalid-value fallback: a malformed value
     (e.g. ``LLMD_MOE_GROUPED_MIN_T=banana``) must degrade to the tuned
-    default, not crash the serving path at trace time."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        return default
+    default, not crash the serving path at trace time.  Shared
+    implementation: ``llm_d_tpu.utils.config.env_int``."""
+    from llm_d_tpu.utils.config import env_int
+    return env_int(name, default)
 
 
 def _sorted_tile_layout(flat: jax.Array, weights_flat: jax.Array,

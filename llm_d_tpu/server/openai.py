@@ -83,10 +83,15 @@ class DPWorkerPool:
     spreads hosts), decode.yaml:75,86.
     """
 
+    # Shipped default; instances read the LLMD_WORKER_BACKOFF_S env knob
+    # (invalid values fall back here).
     WORKER_BACKOFF_S = 15.0
     DEPTH_HEADER = "x-llmd-sched-depth"
 
     def __init__(self, workers: List[str]) -> None:
+        from llm_d_tpu.utils.config import env_float
+        self.worker_backoff_s = env_float("LLMD_WORKER_BACKOFF_S",
+                                          self.WORKER_BACKOFF_S)
         # inflight: open proxied HTTP exchanges (metrics only, NOT load);
         # dispatching: sequence ids of dispatches no depth report has
         # covered yet (see load()); depth: the worker's last
@@ -183,9 +188,9 @@ class DPWorkerPool:
                 await resp.write_eof()
                 return resp
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
-            worker["down_until"] = time.monotonic() + self.WORKER_BACKOFF_S
+            worker["down_until"] = time.monotonic() + self.worker_backoff_s
             logger.warning("DP worker %s unreachable (%s); backing off %.0fs",
-                           worker["url"], exc, self.WORKER_BACKOFF_S)
+                           worker["url"], exc, self.worker_backoff_s)
             if resp is None:
                 return None          # nothing committed: serve locally
             raise                    # mid-stream: the client sees the break

@@ -10,21 +10,33 @@ connector pulls the KV (reference: wide-ep decode.yaml:23-29, SURVEY §3.3).
 This is that proxy for the TPU stack: same ports, same hint header, same
 two-step orchestration, with the ``TpuConnector`` transfer underneath.
 ``--prefiller`` pins a static prefill target for setups without an EPP.
+
+Resilience (P/D-Serve arxiv 2408.08147: per-request failover at the
+routing layer, not pod restart): ``x-prefiller-host-port`` may carry a
+comma-ranked list of prefillers; on 5xx/timeout the sidecar retries the
+next one with capped exponential backoff between rounds, and when every
+prefiller is down it falls back to a full LOCAL prefill on the decode pod
+(the "recompute locally" path) instead of a 502.
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import logging
-from typing import Optional
+from typing import List, Optional
 
 import aiohttp
 from aiohttp import web
 
+from llm_d_tpu.utils.config import env_float, env_int
+from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
+
 logger = logging.getLogger(__name__)
 
 PREFILLER_HEADER = "x-prefiller-host-port"
+FALLBACK_HEADER = "x-llmd-prefill-fallback"
 
 # Hop-by-hop headers a proxy must not forward verbatim.
 _HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
@@ -35,11 +47,23 @@ class RoutingSidecar:
     def __init__(self, decode_url: str,
                  static_prefiller: Optional[str] = None,
                  prefiller_use_tls: bool = False,
-                 prefill_timeout_s: float = 600.0) -> None:
+                 prefill_timeout_s: Optional[float] = None,
+                 prefill_retries: Optional[int] = None,
+                 prefill_backoff_s: Optional[float] = None) -> None:
         self.decode_url = decode_url.rstrip("/")
         self.static_prefiller = static_prefiller
         self.scheme = "https" if prefiller_use_tls else "http"
-        self.prefill_timeout_s = prefill_timeout_s
+        self.prefill_timeout_s = (
+            prefill_timeout_s if prefill_timeout_s is not None
+            else env_float("LLMD_PREFILL_TIMEOUT_S", 600.0))
+        # Failover budget: each ROUND tries every listed prefiller once;
+        # between rounds the sidecar backs off exponentially (capped).
+        self.prefill_retries = (
+            prefill_retries if prefill_retries is not None
+            else env_int("LLMD_PREFILL_RETRIES", 1))
+        self.prefill_backoff_s = (
+            prefill_backoff_s if prefill_backoff_s is not None
+            else env_float("LLMD_PREFILL_BACKOFF_S", 0.1))
         self._session: Optional[aiohttp.ClientSession] = None
 
     # ---------- app ----------
@@ -81,19 +105,68 @@ class RoutingSidecar:
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
 
-        prefiller = request.headers.get(PREFILLER_HEADER) \
-            or self.static_prefiller
-        if prefiller and not body.get("kv_transfer_params"):
-            try:
-                body = await self._run_prefill(request.path, body, prefiller)
-            except PrefillError as e:
-                logger.error("prefill via %s failed: %s", prefiller, e)
-                return web.json_response(
-                    {"error": f"prefill failed: {e}"}, status=502)
+        rid = request.headers.get("x-request-id",
+                                  str(body.get("request_id") or ""))
+        hint = request.headers.get(PREFILLER_HEADER) or \
+            self.static_prefiller or ""
+        prefillers = [p.strip() for p in hint.split(",") if p.strip()]
+        local_fallback = False
+        if prefillers and not body.get("kv_transfer_params"):
+            decode_body = await self._prefill_with_failover(
+                request.path, body, prefillers, rid)
+            if decode_body is None:
+                # Every prefiller is down: recompute locally on the decode
+                # pod (full local prefill — the request survives the
+                # prefill pool outage at the cost of the decode pod's
+                # compute) instead of the old immediate 502.
+                logger.error(
+                    "all %d prefiller(s) failed (request_id=%s); falling "
+                    "back to local prefill on the decode pod",
+                    len(prefillers), rid or "-")
+                local_fallback = True
+            else:
+                body = decode_body
 
         async with self._session.post(
                 f"{self.decode_url}{request.path}", json=body) as upstream:
-            return await self._relay(request, upstream)
+            resp = await self._relay(request, upstream, request_id=rid,
+                                     extra_headers=(
+                                         {FALLBACK_HEADER: "local"}
+                                         if local_fallback else None))
+            return resp
+
+    async def _prefill_with_failover(self, path: str, body: dict,
+                                     prefillers: List[str],
+                                     request_id: str) -> Optional[dict]:
+        """Try each prefiller in ranked order, up to ``prefill_retries + 1``
+        rounds with capped exponential backoff between rounds.  Returns the
+        decode body (kv_transfer_params attached) or None when every
+        attempt failed."""
+        for rnd in range(max(0, self.prefill_retries) + 1):
+            if rnd:
+                # Cap the exponential so a long retry budget cannot park a
+                # live request behind minutes of sleep.
+                await asyncio.sleep(min(
+                    self.prefill_backoff_s * (2 ** (rnd - 1)),
+                    8 * self.prefill_backoff_s))
+            for prefiller in prefillers:
+                try:
+                    out = await self._run_prefill(path, body, prefiller)
+                    if rnd or prefiller != prefillers[0]:
+                        logger.warning(
+                            "prefill failover succeeded via %s "
+                            "(round %d, request_id=%s)", prefiller, rnd,
+                            request_id or "-")
+                    return out
+                except PrefillError as e:
+                    logger.warning(
+                        "prefill via %s failed (round %d, request_id=%s): "
+                        "%s", prefiller, rnd, request_id or "-", e)
+                    if e.permanent:
+                        # Request-level failure: skip the remaining
+                        # failover budget, let the decode pod answer.
+                        return None
+        return None
 
     async def _run_prefill(self, path: str, body: dict, prefiller: str) -> dict:
         """Step 1 of the PD contract: remote prefill, returns the decode body.
@@ -110,15 +183,28 @@ class RoutingSidecar:
         prefill_body["kv_transfer_params"] = {"do_remote_decode": True}
         url = f"{self.scheme}://{prefiller}{path}"
         try:
+            await get_injector().acheck("sidecar.prefill", key=prefiller)
+            # sock_connect bound: a blackholed prefiller (dead node, SYNs
+            # dropped) must cost seconds before failover, not the full
+            # prefill budget (same bound as the gateway's forward path).
             async with self._session.post(
                     url, json=prefill_body,
                     timeout=aiohttp.ClientTimeout(
-                        total=self.prefill_timeout_s)) as resp:
+                        total=self.prefill_timeout_s,
+                        sock_connect=10)) as resp:
                 if resp.status != 200:
-                    raise PrefillError(f"HTTP {resp.status}")
+                    # 4xx is a verdict on the REQUEST, not the prefiller:
+                    # every prefiller would answer the same, so failover
+                    # rounds are wasted work (the decode pod renders the
+                    # authoritative per-request error via local prefill).
+                    raise PrefillError(f"HTTP {resp.status}",
+                                       permanent=400 <= resp.status < 500)
                 payload = await resp.json()
-        except aiohttp.ClientError as e:
-            raise PrefillError(str(e)) from e
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                json.JSONDecodeError, FaultInjected) as e:
+            # JSONDecodeError: a 200 with a garbled/truncated body is a
+            # misbehaving prefiller like any other — fail over, don't 500.
+            raise PrefillError(str(e) or type(e).__name__) from e
         params = payload.get("kv_transfer_params")
         if not params:
             raise PrefillError("prefill response missing kv_transfer_params")
@@ -127,21 +213,56 @@ class RoutingSidecar:
         return decode_body
 
     async def _relay(self, request: web.Request,
-                     upstream: aiohttp.ClientResponse) -> web.StreamResponse:
-        """Stream the upstream response back (SSE-safe chunked relay)."""
+                     upstream: aiohttp.ClientResponse,
+                     request_id: str = "",
+                     extra_headers: Optional[dict] = None
+                     ) -> web.StreamResponse:
+        """Stream the upstream response back (SSE-safe chunked relay).
+
+        A client that disconnects mid-stream must ABORT the upstream
+        decode request — otherwise the engine keeps generating into a dead
+        socket, holding its scheduler slot and KV blocks until max_tokens.
+        ``upstream.close()`` hard-closes the connection, which the decode
+        server sees as a peer disconnect and aborts the request."""
         resp = web.StreamResponse(status=upstream.status)
         for k, v in upstream.headers.items():
             if k.lower() not in _HOP_HEADERS:
                 resp.headers[k] = v
+        for k, v in (extra_headers or {}).items():
+            resp.headers[k] = v
         await resp.prepare(request)
-        async for chunk in upstream.content.iter_any():
-            await resp.write(chunk)
+        try:
+            async for chunk in upstream.content.iter_any():
+                await resp.write(chunk)
+        except ConnectionResetError:
+            # resp.write raising means the CLIENT is gone (upstream-side
+            # failures raise aiohttp.ClientError subclasses and must keep
+            # propagating — an abrupt break is how the still-connected
+            # client learns its stream was truncated).
+            upstream.close()
+            logger.warning("client disconnected mid-stream "
+                           "(request_id=%s); aborted upstream decode",
+                           request_id or "-")
+            return resp
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler on client disconnect: free the
+            # engine slot before propagating.
+            upstream.close()
+            logger.warning("client disconnected mid-stream "
+                           "(request_id=%s); aborted upstream decode",
+                           request_id or "-")
+            raise
         await resp.write_eof()
         return resp
 
 
 class PrefillError(Exception):
-    pass
+    """A failed prefill attempt.  ``permanent`` marks request-level
+    verdicts (4xx) that no alternate prefiller can change."""
+
+    def __init__(self, msg: str, permanent: bool = False) -> None:
+        super().__init__(msg)
+        self.permanent = permanent
 
 
 def main(argv=None) -> None:
@@ -158,10 +279,22 @@ def main(argv=None) -> None:
                    help="accepted for reference-flag compatibility "
                         "(--connector=nixlv2 analogue); only 'tpu' exists")
     p.add_argument("--prefiller-use-tls", action="store_true")
+    p.add_argument("--prefill-timeout", type=float, default=None,
+                   help="per-attempt prefill timeout in seconds "
+                        "(default LLMD_PREFILL_TIMEOUT_S or 600)")
+    p.add_argument("--prefill-retries", type=int, default=None,
+                   help="extra failover rounds over the prefiller list "
+                        "(default LLMD_PREFILL_RETRIES or 1)")
+    p.add_argument("--prefill-backoff", type=float, default=None,
+                   help="base backoff between failover rounds, seconds "
+                        "(default LLMD_PREFILL_BACKOFF_S or 0.1)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     sidecar = RoutingSidecar(args.decode_url, args.prefiller,
-                             prefiller_use_tls=args.prefiller_use_tls)
+                             prefiller_use_tls=args.prefiller_use_tls,
+                             prefill_timeout_s=args.prefill_timeout,
+                             prefill_retries=args.prefill_retries,
+                             prefill_backoff_s=args.prefill_backoff)
     web.run_app(sidecar.build_app(), host=args.host, port=args.port)
 
 

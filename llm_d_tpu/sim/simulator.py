@@ -265,6 +265,7 @@ class SimServer:
         async for text, _first in self.sim.run_request(prompt_ids, max_tokens):
             parts.append(text)
         full = "".join(parts)
+        ktp = body.get("kv_transfer_params") or {}
         payload = {
             "id": rid,
             "object": "chat.completion" if chat else "text_completion",
@@ -282,6 +283,19 @@ class SimServer:
                 "total_tokens": len(prompt_ids) + max_tokens,
             },
         }
+        if ktp.get("do_remote_decode"):
+            # PD producer contract (README.tpu.md:182-189): a
+            # do_remote_decode prefill answers with the transfer params the
+            # sidecar attaches for the decode pull.  The sim has no KV to
+            # move, so the params are synthetic — enough for the sidecar /
+            # chaos suite to exercise the full two-step orchestration on
+            # CPU-only machines.
+            payload["kv_transfer_params"] = {
+                "remote_block_ids": list(range(
+                    len(prompt_ids) // self.sim.config.block_size + 1)),
+                "remote_host": "sim", "remote_port": 0, "uuid": rid,
+                "sim": True,
+            }
         return web.json_response(payload)
 
 

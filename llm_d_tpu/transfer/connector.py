@@ -39,6 +39,8 @@ import numpy as np
 
 from llm_d_tpu.engine.request import Request, RequestOutput, RequestState
 from llm_d_tpu.transfer import transport
+from llm_d_tpu.utils.config import env_float, env_int
+from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 
 logger = logging.getLogger(__name__)
 
@@ -66,6 +68,14 @@ class KVConnectorConfig:
     # are released after this long (the reference leans on request timeouts;
     # an engine must not leak cache to a dead peer).
     pin_timeout_s: float = 120.0
+    # Consumer-side retry budget BEFORE kv_load_failure_policy applies: a
+    # transient drop (P/D-Serve reports failed P->D transfers dominate
+    # per-request failures at scale) costs one short backoff instead of an
+    # abort or a full local recompute.
+    pull_retries: int = dataclasses.field(
+        default_factory=lambda: env_int("LLMD_KV_PULL_RETRIES", 2))
+    pull_backoff_s: float = dataclasses.field(
+        default_factory=lambda: env_float("LLMD_KV_PULL_BACKOFF_S", 0.05))
 
 
 class TpuConnector:
@@ -141,18 +151,50 @@ class TpuConnector:
         t0 = time.perf_counter()
         blob: Optional[bytes] = None
         error: Optional[str] = None
+        retries = max(0, self.config.pull_retries)
         try:
+            # Malformed params are PERMANENT: fail straight to policy, no
+            # retry/backoff (only transport-level failures are transient).
             host = params["remote_host"]
             port = int(params["remote_port"])
             uuid = params.get("uuid", req.request_id)
-            blob = transport.fetch(host, port, uuid,
-                                   timeout_ms=self.config.timeout_ms)
-            # The slab is on this host now; free the producer immediately
-            # (its pinned prefill blocks return to the pool).
-            transport.release(host, port, uuid,
-                              timeout_ms=self.config.timeout_ms)
-        except (transport.TransferError, KeyError, OSError, ValueError) as e:
-            error = f"{type(e).__name__}: {e}"
+        except (KeyError, TypeError, ValueError) as e:
+            self._loaded.put((req, None, f"{type(e).__name__}: {e}",
+                              time.perf_counter() - t0))
+            return
+        for attempt in range(retries + 1):
+            error = None
+            try:
+                get_injector().check("kv.pull", key=f"{host}:{port}")
+                blob = transport.fetch(host, port, uuid,
+                                       timeout_ms=self.config.timeout_ms)
+            except (transport.TransferNotFound, KeyError) as e:
+                # Slab absent on a REACHABLE producer: the pin expired or
+                # the uuid is stale — permanent, retrying can only burn
+                # backoff before the policy decision (producers register
+                # the slab BEFORE answering kv_transfer_params).
+                error = f"{type(e).__name__}: {e}"
+                break
+            except (transport.TransferError, OSError, ValueError,
+                    FaultInjected) as e:
+                error = f"{type(e).__name__}: {e}"
+                if attempt < retries:
+                    logger.warning(
+                        "kv pull for %s failed (%s); retry %d/%d",
+                        req.request_id, error, attempt + 1, retries)
+                    time.sleep(self.config.pull_backoff_s * (2 ** attempt))
+                continue
+            try:
+                # The slab is on this host now; free the producer
+                # immediately (its pinned prefill blocks return to the
+                # pool).  A failed release must NOT fail the load — the
+                # producer's pin timeout reclaims the blocks.
+                transport.release(host, port, uuid,
+                                  timeout_ms=self.config.timeout_ms)
+            except (transport.TransferError, OSError, ValueError) as e:
+                logger.warning("kv release for %s failed (%s); producer "
+                               "pin timeout will reclaim", req.request_id, e)
+            break
         self._loaded.put((req, blob, error, time.perf_counter() - t0))
 
     def abort(self, request_id: str) -> None:

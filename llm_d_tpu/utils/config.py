@@ -16,9 +16,41 @@ Merge semantics: dicts merge recursively; scalars and lists replace.
 from __future__ import annotations
 
 import copy
+import logging
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import yaml
+
+logger = logging.getLogger(__name__)
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer env knob with invalid-value fallback: a malformed value
+    (``LLMD_PEER_FAILURE_LIMIT=banana``) must degrade to the shipped
+    default, not crash the serving path."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("%s=%r is not an int; using default %s",
+                       name, raw, default)
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with invalid-value fallback (see :func:`env_int`)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("%s=%r is not a float; using default %s",
+                       name, raw, default)
+        return default
 
 
 def deep_merge(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:

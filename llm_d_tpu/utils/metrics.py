@@ -147,6 +147,23 @@ class EppMetrics:
         self.shed_total = Counter(
             "inference_objective_request_shed_total",
             "Requests shed due to SLO headroom exhaustion.", registry=self.registry)
+        # Request-level resilience (breaker + retry-on-alternate-endpoint).
+        self.breaker_state = Gauge(
+            "llmd_tpu:endpoint_breaker_state",
+            "Per-endpoint circuit breaker state (0=closed, 1=open, "
+            "2=half-open).", ["endpoint"], registry=self.registry)
+        self.breaker_transitions = Counter(
+            "llmd_tpu:endpoint_breaker_transitions_total",
+            "Breaker state transitions.", ["endpoint", "to"],
+            registry=self.registry)
+        self.gateway_retries = Counter(
+            "llmd_tpu:gateway_retries_total",
+            "Forwards retried on an alternate endpoint.", ["reason"],
+            registry=self.registry)
+        self.gateway_retry_exhausted = Counter(
+            "llmd_tpu:gateway_retry_exhausted_total",
+            "Requests that failed after the full retry budget.",
+            registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -13,6 +13,14 @@ Examples:
       --prefix-groups 4            # warms the prefix scorers
   python scripts/generate_load.py --url http://gw:8000 --shape slo \
       --slo-ttft-ms 200 --error-rate 0.1
+  python scripts/generate_load.py --url http://gw:8000 --qps 10 \
+      --faults malformed:0.1,abort:0.05,timeout:0.02   # chaos traffic
+
+Client-side fault kinds (--faults kind:rate[,kind:rate...], mirroring the
+reference error-injection load script):
+  malformed  invalid request body (error handling / 400 path)
+  abort      client disconnects mid-stream (sidecar/_relay + engine abort)
+  timeout    50ms client timeout (slow-upstream / hung-client path)
 """
 
 from __future__ import annotations
@@ -50,14 +58,53 @@ def make_body(args, rng: random.Random) -> tuple:
     return body, headers
 
 
+def parse_faults(spec: str) -> dict:
+    """"kind:rate[,kind:rate...]" -> {kind: rate}; bad entries dropped."""
+    out = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, _, rate = entry.partition(":")
+        try:
+            out[kind.strip()] = float(rate)
+        except ValueError:
+            print(f"--faults: dropping malformed entry {entry!r}")
+    return out
+
+
+def pick_fault(faults: dict, rng: random.Random):
+    for kind, rate in faults.items():
+        if rng.random() < rate:
+            return kind
+    return None
+
+
 async def one_request(session, args, rng, stats) -> None:
     body, headers = make_body(args, rng)
+    fault = pick_fault(args.fault_map, rng)
     t0 = time.perf_counter()
     try:
-        async with session.post(f"{args.url}/v1/completions", json=body,
-                                headers=headers) as resp:
-            await resp.read()
-            stats[resp.status] = stats.get(resp.status, 0) + 1
+        if fault == "malformed":
+            body = {"prompt": None, "max_tokens": "boom"}
+        kw = {}
+        if fault == "timeout":
+            kw["timeout"] = aiohttp.ClientTimeout(total=0.05)
+        if fault == "abort":
+            body = dict(body, stream=True)
+            async with session.post(f"{args.url}/v1/completions", json=body,
+                                    headers=headers) as resp:
+                # Read one chunk then slam the connection shut: exercises
+                # the sidecar/_relay + engine abort-on-disconnect path.
+                async for _chunk in resp.content.iter_any():
+                    break
+                resp.close()
+            stats["aborted"] = stats.get("aborted", 0) + 1
+        else:
+            async with session.post(f"{args.url}/v1/completions", json=body,
+                                    headers=headers, **kw) as resp:
+                await resp.read()
+                stats[resp.status] = stats.get(resp.status, 0) + 1
     except Exception:
         stats["error"] = stats.get("error", 0) + 1
     stats.setdefault("latencies", []).append(time.perf_counter() - t0)
@@ -106,8 +153,14 @@ def main() -> None:
     ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
     ap.add_argument("--slo-tpot-ms", type=float, default=50.0)
     ap.add_argument("--error-rate", type=float, default=0.0)
+    ap.add_argument("--faults", default="",
+                    help="client-side fault mix, kind:rate[,kind:rate...]; "
+                         "kinds: malformed, abort, timeout (see module "
+                         "docstring)")
     ap.add_argument("--seed", type=int, default=0)
-    asyncio.run(run(ap.parse_args()))
+    args = ap.parse_args()
+    args.fault_map = parse_faults(args.faults)
+    asyncio.run(run(args))
 
 
 if __name__ == "__main__":

@@ -248,3 +248,48 @@ def test_worker_url_derivation_and_cli():
     assert args.data_parallel_size_local == 2
     assert args.data_parallel_start_rank == 2
     assert args.data_parallel_hybrid_lb
+
+
+def test_pool_dead_worker_backoff_expiry(monkeypatch):
+    """A dead pod must not keep winning the pick while its backoff is
+    live, and MUST be re-probed once the backoff lapses (ISSUE 3
+    satellite: the expiry path had no coverage).  Also pins the
+    LLMD_WORKER_BACKOFF_S env knob (invalid values fall back)."""
+    import time
+
+    monkeypatch.setenv("LLMD_WORKER_BACKOFF_S", "0.2")
+    pool = DPWorkerPool([f"http://127.0.0.1:{free_port()}", "http://w2"])
+    assert pool.worker_backoff_s == 0.2
+    monkeypatch.setenv("LLMD_WORKER_BACKOFF_S", "banana")
+    assert DPWorkerPool(["http://x"]).worker_backoff_s \
+        == DPWorkerPool.WORKER_BACKOFF_S          # invalid -> default
+    dead, live = pool.workers
+
+    class Sched:
+        num_waiting, num_running = 5, 0
+
+    class Eng:
+        scheduler = Sched()
+
+    class Req:
+        path_qs = "/v1/completions"
+        headers = {}
+
+    async def run():
+        # Nothing listens on the dead worker's port: the proxy attempt
+        # fails before any bytes are committed -> None (serve locally) and
+        # the worker enters backoff.
+        out = await pool.proxy(Req(), {"prompt": "x"}, dead)
+        assert out is None
+        assert dead["down_until"] > time.monotonic()
+        # During the backoff the dead worker must not win the
+        # least-loaded race even though it looks idle (load 0).
+        live["depth"] = 3
+        assert pool.pick(Eng()) is live
+        # Once the backoff lapses the worker is eligible again (re-probed
+        # by the next pick, NOT blackholed forever).
+        await asyncio.sleep(0.25)
+        assert pool.pick(Eng()) is dead
+        await pool.close()
+
+    asyncio.run(run())

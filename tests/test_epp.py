@@ -206,6 +206,45 @@ schedulingProfiles:
     assert result.headers[DESTINATION_HEADER] == "10.0.0.2:8000"
 
 
+def test_prefill_header_ranks_alternates():
+    """With several prefillers the hint header carries the winner plus
+    score-ranked runners-up — the sidecar's failover list (single-
+    prefiller pools keep the bare-address wire format)."""
+    cfg = parse_config("""
+kind: EndpointPickerConfig
+plugins:
+- type: pd-profile-handler
+  parameters: {threshold: 0}
+- type: prefill-header-handler
+- type: prefill-filter
+- type: decode-filter
+- type: queue-scorer
+- type: max-score-picker
+schedulingProfiles:
+- name: prefill
+  plugins:
+  - pluginRef: prefill-filter
+  - pluginRef: queue-scorer
+  - pluginRef: max-score-picker
+- name: decode
+  plugins:
+  - pluginRef: decode-filter
+  - pluginRef: queue-scorer
+  - pluginRef: max-score-picker
+""")
+    eps = [EndpointState(address=f"10.0.0.{i}:8000", role="prefill",
+                         ready=True) for i in range(3)]
+    eps.append(EndpointState(address="10.0.0.9:8000", role="decode",
+                             ready=True))
+    eps[0].num_waiting, eps[1].num_waiting, eps[2].num_waiting = 5, 0, 2
+    ds = Datastore(eps, scrape_interval_s=999)
+    sched = EppScheduler(cfg, ds)
+    result = sched.schedule(RequestCtx(body={}, token_ids=[1] * 64))
+    ranked = result.headers["x-prefiller-host-port"].split(",")
+    # Winner first (least queue), then runners-up by score.
+    assert ranked == ["10.0.0.1:8000", "10.0.0.2:8000", "10.0.0.0:8000"]
+
+
 # ---------- e2e: gateway + 3 simulator replicas ----------
 
 async def _start_app(app, port):
