@@ -82,6 +82,18 @@ class AsyncEngine:
 
     # ---------- event-loop side ----------
 
+    def abort(self, request_id: str, notify: bool = False) -> None:
+        """Abort a request from the event-loop side (drain timeout, admin
+        cancel).  ``notify=True`` also terminates the request's stream with
+        a finished "abort" output — callers use it when the CLIENT is still
+        connected and would otherwise wait forever (the engine emits no
+        output for aborts)."""
+        self._inbox.put(("abort", request_id))
+        self._wake.set()
+        if notify and self._loop is not None:
+            self._loop.call_soon_threadsafe(self._dispatch, [
+                RequestOutput(request_id, [], True, finish_reason="abort")])
+
     def _dispatch(self, outputs) -> None:
         for out in outputs:
             q = self._streams.get(out.request_id)

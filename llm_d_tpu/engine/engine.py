@@ -596,6 +596,10 @@ class EngineCore:
             req = sr.request
             if req.state is not RequestState.RUNNING:
                 continue
+            if req.deadline_expired():
+                # Drain the pipeline so the next step's schedule() pass
+                # evicts the expired request and frees its blocks.
+                return None
             if int(meta["pos0"][s]) + 2 * K >= max_len:
                 return None
             if int(meta["gen0"][s]) + K < req.sampling.max_tokens:
@@ -848,7 +852,16 @@ class EngineCore:
             self._inflight = nxt
             return outputs
         sched = self.scheduler.schedule()
-        for req in sched.preempted:      # oversized requests finished by scheduler
+        sched_now = time.monotonic()
+        for sr in sched.scheduled:
+            if sr.is_first_schedule and not sr.request.queue_wait_observed:
+                sr.request.queue_wait_observed = True
+                self.metrics.observe_queue_wait(
+                    sr.request.criticality,
+                    max(0.0, sched_now - sr.request.arrival_time))
+        for req in sched.preempted:      # requests finished by the scheduler
+            if req.state is RequestState.FINISHED_DEADLINE:
+                self.metrics.inc_deadline_exceeded(req.criticality)
             outputs.append(RequestOutput(
                 req.request_id, [], True, finish_reason=req.state.value))
         if sched.empty:

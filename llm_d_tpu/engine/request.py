@@ -8,6 +8,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.utils.lifecycle import CRITICALITY_TIERS
 
 
 class RequestState(enum.Enum):
@@ -17,6 +18,10 @@ class RequestState(enum.Enum):
     FINISHED_STOPPED = "stop"          # hit stop token / stop string
     FINISHED_LENGTH = "length"         # hit max_tokens / max_model_len
     FINISHED_ABORTED = "abort"
+    # Deadline passed while queued or running: the scheduler refuses /
+    # evicts and frees KV blocks the same step (the server renders 504
+    # with x-llmd-deadline-exceeded).
+    FINISHED_DEADLINE = "deadline"
     # PD: prefill done on a producer engine, KV ready for remote pull
     # (reference contract: README.tpu.md:182-189 kv_transfer_params).
     FINISHED_REMOTE_PREFILL = "remote_prefill"
@@ -26,6 +31,7 @@ class RequestState(enum.Enum):
         return self in (RequestState.FINISHED_STOPPED,
                         RequestState.FINISHED_LENGTH,
                         RequestState.FINISHED_ABORTED,
+                        RequestState.FINISHED_DEADLINE,
                         RequestState.FINISHED_REMOTE_PREFILL)
 
 
@@ -36,6 +42,14 @@ class Request:
     sampling: SamplingParams
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
     priority: int = 0
+    # SLO class (critical | standard | sheddable): a priority TIER above
+    # the per-request ``priority`` int — it drives queue order, preemption
+    # victim selection (sheddable shed first), and metric labels.
+    criticality: str = "standard"
+    # Absolute deadline on the ENGINE clock (time.monotonic()); None = no
+    # budget.  The scheduler refuses expired queued requests and evicts
+    # expired running ones at step boundaries.
+    deadline: Optional[float] = None
 
     state: RequestState = RequestState.WAITING
     output_token_ids: List[int] = dataclasses.field(default_factory=list)
@@ -44,6 +58,10 @@ class Request:
     block_ids: List[int] = dataclasses.field(default_factory=list)
     num_cached_prompt_tokens: int = 0      # prefix-cache hits (metrics/scoring)
     num_preemptions: int = 0
+    # Queue-wait metric latch: preemption resets the computed-token state,
+    # so ``is_first_schedule`` fires again on re-admission — without this
+    # the histogram would record run time as queue wait.
+    queue_wait_observed: bool = False
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None
 
@@ -53,6 +71,17 @@ class Request:
     kv_transfer_params: Optional[Dict[str, Any]] = None
     do_remote_prefill: bool = False    # consumer side: pull KV before decode
     do_remote_decode: bool = False     # producer side: stop after prefill
+
+    @property
+    def slo_tier(self) -> int:
+        """Criticality as a priority tier (critical=-1 < standard=0 <
+        sheddable=1); unknown classes behave as standard."""
+        return CRITICALITY_TIERS.get(self.criticality, 0)
+
+    def deadline_expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.monotonic()) > self.deadline
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -7,15 +7,22 @@ Unified steps (prefills and decodes in one batch) keep the TPU busy with
 large matmuls while decode latency stays bounded by the token budget.
 
 Scheduling policy: running requests first (decode steps starve last),
-then waiting requests FIFO by (priority, arrival).  On block exhaustion the
-most recently added running request is preempted and recomputed later
-(metric: ``vllm:num_preemptions_total``).
+then waiting requests FIFO by (criticality tier, priority, arrival).  On
+block exhaustion the most recently added running request in the lowest
+SLO class is preempted and recomputed later (sheddable before standard
+before critical; metric: ``vllm:num_preemptions_total``).
+
+Lifecycle: requests carry an optional absolute deadline.  Every
+``schedule()`` pass first expires deadlines — queued requests whose
+budget passed are refused, running ones are evicted at the step boundary
+— and frees their KV blocks the same step (the server renders the 504).
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Dict, List, Optional, Tuple
 
 from llm_d_tpu.engine.kv_cache import KVCacheManager
@@ -55,6 +62,7 @@ class Scheduler:
         self.waiting: collections.deque[Request] = collections.deque()
         self.running: List[Request] = []
         self.num_preemptions = 0
+        self.num_deadline_evictions = 0
         # Blocks held outside the scheduler (e.g. PD producer pins awaiting a
         # remote pull). While any exist, a stalled sole-running request waits
         # for their asynchronous release instead of being aborted.
@@ -91,7 +99,10 @@ class Scheduler:
 
     def _preempt_for(self, needy: Request, preempted_now: set,
                      scheduled_ids: set) -> bool:
-        """Preempt the most recent running request other than ``needy``.
+        """Preempt the most recent running request in the LOWEST SLO class
+        other than ``needy`` (sheddable victims before standard before
+        critical; most-recent-first within a class, so the class tiers
+        only reorder — the historical recency policy is the tie-break).
 
         Requests already scheduled in this pass are not eligible victims:
         freeing their blocks after they were appended to ``scheduled`` would
@@ -100,7 +111,10 @@ class Scheduler:
         blocks cannot satisfy ``needy``'s allocation.
         """
         region = self.kv.region_of_request(needy)
-        for victim in reversed(self.running):
+        # Stable sort over reversed(running): most-recent-first within each
+        # tier, tiers from sheddable down to critical.
+        victims = sorted(reversed(self.running), key=lambda r: -r.slo_tier)
+        for victim in victims:
             if victim is needy or victim.request_id in scheduled_ids:
                 continue
             if self.kv.num_regions > 1 \
@@ -117,9 +131,26 @@ class Scheduler:
             return True
         return False
 
+    def _expire_deadlines(self, expired_out: List[Request]) -> None:
+        """Refuse queued requests and evict running ones whose deadline
+        passed; their KV blocks return to the pool THIS step (a request
+        that already blew its budget must not keep burning TPU steps and
+        cache).  Evicted requests finish with state FINISHED_DEADLINE —
+        the engine surfaces them as outputs and the server maps them to
+        504 + x-llmd-deadline-exceeded."""
+        now = time.monotonic()
+        for q in (self.waiting, self.running):
+            for req in [r for r in list(q) if r.deadline_expired(now)]:
+                q.remove(req)
+                self.kv.free(req)
+                req.state = RequestState.FINISHED_DEADLINE
+                self.num_deadline_evictions += 1
+                expired_out.append(req)
+
     def schedule(self) -> SchedulerOutput:
         scheduled: List[ScheduledRequest] = []
         preempted: List[Request] = []
+        self._expire_deadlines(preempted)
         budget = self.max_num_batched_tokens
         # Requests preempted during this pass are not re-admitted in the same
         # step: re-admission would recreate the memory pressure that forced
@@ -183,9 +214,12 @@ class Scheduler:
             scheduled.append(ScheduledRequest(req, n))
             scheduled_ids.add(req.request_id)
 
-        # 2. Waiting requests, FIFO within priority
-        # (lower priority value = more important, matching InferenceObjective).
-        pending = sorted(self.waiting, key=lambda r: (r.priority, r.arrival_time))
+        # 2. Waiting requests, FIFO within (criticality tier, priority)
+        # (lower value = more important, matching InferenceObjective; the
+        # SLO class is the outer tier, per-request priority the inner).
+        pending = sorted(self.waiting,
+                         key=lambda r: (r.slo_tier, r.priority,
+                                        r.arrival_time))
         for req in pending:
             if budget <= 0 or len(self.running) >= self.max_num_seqs:
                 break

@@ -111,6 +111,7 @@ apiVersion: inference.networking.x-k8s.io/v1alpha1
 kind: EndpointPickerConfig
 plugins:
 - type: single-profile-handler
+- type: drain-filter
 - type: circuit-breaker-filter
 - type: queue-scorer
 - type: kv-cache-utilization-scorer
@@ -122,6 +123,7 @@ plugins:
 schedulingProfiles:
 - name: default
   plugins:
+  - pluginRef: drain-filter
   - pluginRef: circuit-breaker-filter
   - pluginRef: queue-scorer
     weight: 2

@@ -202,6 +202,11 @@ class EndpointState:
     num_running: float = 0.0
     kv_usage: float = 0.0             # 0..1
     ready: bool = False
+    # Replica announced it is draining (llmd_tpu:drain_state metric): the
+    # drain-filter excludes it from new assignments while its in-flight
+    # requests complete (scrape-level signal — /metrics stays up while
+    # readiness is already 503).
+    draining: bool = False
     last_scrape: float = 0.0
     scrape_error: Optional[str] = None
 
@@ -343,6 +348,7 @@ class Datastore:
             e.num_waiting = m.get("vllm:num_requests_waiting", 0.0)
             e.num_running = m.get("vllm:num_requests_running", 0.0)
             e.kv_usage = m.get(self.kv_usage_metric, 0.0)
+            e.draining = m.get("llmd_tpu:drain_state", 0.0) >= 1.0
             e.ready = True
             e.scrape_error = None
             e.last_scrape = time.monotonic()

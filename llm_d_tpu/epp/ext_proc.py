@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from concurrent import futures
 from typing import Iterator, Optional
 
@@ -38,6 +39,12 @@ import grpc
 
 from llm_d_tpu.epp.protos import external_processor_pb2 as pb
 from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
+from llm_d_tpu.utils.config import env_int
+from llm_d_tpu.utils.lifecycle import (
+    CRITICALITY_HEADER,
+    DEADLINE_ABS_HEADER,
+    remaining_s,
+)
 from llm_d_tpu.epp.plugins import RequestCtx
 
 logger = logging.getLogger(__name__)
@@ -65,27 +72,37 @@ class SyncFlowControl:
         self.max_inflight = max_inflight
         self.max_queue = max_queue
         self.queue_timeout_s = queue_timeout_s
+        # Same SLO-class contract as the HTTP plane's FlowControl.
+        self.critical_reserve = env_int("LLMD_SLO_CRITICAL_RESERVE", 8)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._inflight = 0
         self._queued = 0
 
-    def acquire(self, sheddable: bool) -> str:
+    def acquire(self, sheddable: bool, criticality: str = "standard",
+                max_wait_s: Optional[float] = None) -> str:
         """"ok" (slot held), "saturated" (sheddable), "queue_full",
-        or "timeout"."""
+        or "timeout".  Mirrors ``service.FlowControl``: sheddable never
+        queues, critical keeps reserve queue seats, and ``max_wait_s``
+        (remaining deadline budget) caps the wait below the timeout."""
         with self._cv:
             if self._inflight < self.max_inflight and self._queued == 0:
                 self._inflight += 1
                 return "ok"
             if sheddable:
                 return "saturated"
-            if self._queued >= self.max_queue:
+            limit = self.max_queue + (
+                self.critical_reserve if criticality == "critical" else 0)
+            if self._queued >= limit:
                 return "queue_full"
+            timeout = self.queue_timeout_s
+            if max_wait_s is not None:
+                timeout = max(0.0, min(timeout, max_wait_s))
             self._queued += 1
             try:
                 ok = self._cv.wait_for(
                     lambda: self._inflight < self.max_inflight,
-                    timeout=self.queue_timeout_s)
+                    timeout=timeout)
                 if not ok:
                     return "timeout"
                 self._inflight += 1
@@ -186,16 +203,31 @@ class ExtProcHandler:
             ctx = RequestCtx.from_request(payload, headers)
         except (TypeError, ValueError) as exc:
             return _immediate(400, f"invalid request: {exc}")
+
+        def expired() -> bool:
+            return ctx.deadline_epoch is not None \
+                and time.time() > ctx.deadline_epoch
+        if expired():
+            return _immediate(504, "deadline exceeded")
         if self.flow is not None:
-            verdict = self.flow.acquire(sheddable=ctx.priority < 0)
+            verdict = self.flow.acquire(
+                sheddable=ctx.priority < 0
+                or ctx.criticality == "sheddable",
+                criticality=ctx.criticality,
+                max_wait_s=remaining_s(ctx.deadline_epoch))
             if verdict == "saturated":
                 self.scheduler.metrics.shed_total.inc()
                 return _immediate(429, "saturated: sheddable request")
+            if verdict in ("queue_full", "timeout") and expired():
+                # A deadline-capped queue timeout is a deadline miss.
+                return _immediate(504, "deadline exceeded")
             if verdict == "queue_full":
                 return _immediate(429, "flow control queue full")
             if verdict == "timeout":
                 return _immediate(503, "flow control queue timeout")
         try:
+            if expired():        # queue wait may have eaten the budget
+                return _immediate(504, "deadline exceeded")
             result = self.scheduler.schedule(ctx)
         except (TypeError, ValueError) as exc:
             return _immediate(400, f"invalid request: {exc}")
@@ -210,6 +242,12 @@ class ExtProcHandler:
             return _immediate(503, "no ready endpoints")
         out_headers = dict(result.headers)
         out_headers[DESTINATION_HEADER] = result.primary.address
+        # Lifecycle contract rides to the upstream on this plane too: the
+        # absolute deadline is stamped HERE (first hop) so the model
+        # server's budget includes ext_proc queue time.
+        out_headers[CRITICALITY_HEADER] = ctx.criticality
+        if ctx.deadline_epoch is not None:
+            out_headers[DEADLINE_ABS_HEADER] = f"{ctx.deadline_epoch:.6f}"
         new_body = None
         if ctx.predictions:
             # Ride the predictions to the model server (same contract as

@@ -42,6 +42,13 @@ class RequestCtx:
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
     priority: int = 0
+    # SLO class (critical | standard | sheddable; x-llmd-criticality /
+    # body "criticality"): drives gateway admission under saturation and
+    # rides to the model server's tiered scheduler.
+    criticality: str = "standard"
+    # Absolute unix-epoch deadline (x-llmd-deadline-ms / body "timeout");
+    # stamped by the gateway and propagated to every later hop.
+    deadline_epoch: Optional[float] = None
     shed: bool = False
     predictions: Dict[str, float] = dataclasses.field(default_factory=dict)
     # Retry-on-alternate-endpoint: addresses whose forward already failed
@@ -71,9 +78,13 @@ class RequestCtx:
         elif "messages" in body:
             text = "".join(m.get("content", "")
                            for m in body.get("messages", []))
+        from llm_d_tpu.utils.lifecycle import (
+            parse_criticality, parse_deadline)
         return cls(body=body, prompt_text=text, token_ids=token_ids,
                    headers={}, in_headers=in_headers,
                    priority=int(body.get("priority") or 0),
+                   criticality=parse_criticality(in_headers, body),
+                   deadline_epoch=parse_deadline(in_headers, body),
                    request_id=in_headers.get(
                        "x-request-id", body.get("request_id", "")))
 
@@ -136,6 +147,20 @@ class DecodeFilter(Plugin):
 
     def filter(self, ctx, candidates):
         return [e for e in candidates if e.role in ("decode", "both")]
+
+
+class DrainFilter(Plugin):
+    """Drop endpoints that announced they are draining
+    (``EndpointState.draining``, scraped from ``llmd_tpu:drain_state``):
+    a replica finishing its in-flight work before a restart must stop
+    winning picks even while its scrape still answers.
+
+    Strict (no fail-open): a draining replica refuses new inference with
+    503 anyway, so passing it through under a fully-draining fleet only
+    converts a fast 503 into a forward-then-retry loop."""
+
+    def filter(self, ctx, candidates):
+        return [e for e in candidates if not e.draining]
 
 
 class CircuitBreakerFilter(Plugin):
@@ -446,7 +471,7 @@ class SloScorer(Plugin):
             any_positive = any_positive or positive
             head[e.address] = (positive, h_ttft, h_tpot)
         if ctx.slo_ttft_ms is not None and not any_positive \
-                and ctx.priority < 0:
+                and (ctx.priority < 0 or ctx.criticality == "sheddable"):
             ctx.shed = True
         out: Scores = {}
         # Buckets normalize separately: within POSITIVE the strategy
@@ -540,6 +565,7 @@ class PrefillHeaderHandler(Plugin):
 PLUGIN_TYPES = {
     "prefill-filter": PrefillFilter,
     "decode-filter": DecodeFilter,
+    "drain-filter": DrainFilter,
     "circuit-breaker-filter": CircuitBreakerFilter,
     "queue-scorer": QueueScorer,
     "kv-cache-utilization-scorer": KvCacheUtilizationScorer,

@@ -24,6 +24,7 @@ import argparse
 import asyncio
 import json
 import logging
+import time
 from typing import Dict, List, Optional
 
 import aiohttp
@@ -36,6 +37,13 @@ from llm_d_tpu.epp.plugins import RequestCtx
 from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
 from llm_d_tpu.utils.config import env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
+from llm_d_tpu.utils.lifecycle import (
+    CRITICALITY_HEADER,
+    DEADLINE_ABS_HEADER,
+    DEADLINE_EXCEEDED_HEADER,
+    parse_criticality,
+    parse_deadline,
+)
 from llm_d_tpu.utils.metrics import EppMetrics
 
 logger = logging.getLogger(__name__)
@@ -60,20 +68,32 @@ class FlowControl:
     an upstream slot; excess waits in a bounded FIFO up to
     ``queue_timeout_s``.  Under saturation the gateway degrades to bounded
     latency + fast rejection instead of fanning unbounded concurrency at
-    the model servers.  Sheddable requests (priority < 0) never queue —
-    they 429 immediately, consistent with SLO shedding."""
+    the model servers.
+
+    Admission is SLO-class-aware — low classes shed before high ones
+    queue: sheddable requests (class ``sheddable`` or priority < 0) never
+    queue, they 429 immediately; standard requests queue up to
+    ``max_queue``; critical requests keep ``critical_reserve`` extra queue
+    seats (``LLMD_SLO_CRITICAL_RESERVE``) so a standard-traffic burst
+    cannot starve them out of the queue."""
 
     def __init__(self, max_inflight: int, max_queue: int,
                  queue_timeout_s: float, metrics) -> None:
         self._sem = asyncio.Semaphore(max_inflight)
         self.max_queue = max_queue
         self.queue_timeout_s = queue_timeout_s
+        self.critical_reserve = env_int("LLMD_SLO_CRITICAL_RESERVE", 8)
         self._queued = 0
         self.metrics = metrics
 
-    async def acquire(self, sheddable: bool) -> str:
+    async def acquire(self, sheddable: bool,
+                      criticality: str = "standard",
+                      max_wait_s: Optional[float] = None) -> str:
         """Returns "ok" (slot held), "saturated" (sheddable, no slot),
-        "queue_full", or "timeout"."""
+        "queue_full", or "timeout".  ``max_wait_s`` caps the queue wait
+        below ``queue_timeout_s`` (the request's remaining deadline
+        budget): a request whose deadline will expire mid-queue must not
+        hold a scarce queue seat past the point it is already dead."""
         # Fast path only when nobody is parked: on Python <= 3.11
         # Semaphore.acquire is not FIFO-fair, so without the _queued gate a
         # steady arrival stream would barge past queued waiters until they
@@ -83,15 +103,19 @@ class FlowControl:
             return "ok"
         if sheddable:
             return "saturated"
-        if self._queued >= self.max_queue:
+        limit = self.max_queue + (
+            self.critical_reserve if criticality == "critical" else 0)
+        if self._queued >= limit:
             self.metrics.flow_control_rejects.labels(
                 reason="queue_full").inc()
             return "queue_full"
         self._queued += 1
         self.metrics.flow_control_queue.set(self._queued)
+        timeout = self.queue_timeout_s
+        if max_wait_s is not None:
+            timeout = max(0.0, min(timeout, max_wait_s))
         try:
-            await asyncio.wait_for(self._sem.acquire(),
-                                   self.queue_timeout_s)
+            await asyncio.wait_for(self._sem.acquire(), timeout)
             return "ok"
         except asyncio.TimeoutError:
             self.metrics.flow_control_rejects.labels(reason="timeout").inc()
@@ -177,9 +201,27 @@ class Gateway:
             return web.json_response(
                 {"error": "invalid request: priority must be an int"},
                 status=400)
+        in_headers = {k.lower(): v for k, v in request.headers.items()}
+        try:
+            criticality = parse_criticality(in_headers, body)
+            # Stamp the ABSOLUTE deadline here, at the first hop: later
+            # hops must inherit it, not re-base the relative budget after
+            # queueing already spent part of it.
+            deadline_epoch = parse_deadline(in_headers, body)
+        except ValueError as exc:
+            return web.json_response(
+                {"error": f"invalid request: {exc}"}, status=400)
+        expired = self._deadline_expired(criticality, deadline_epoch)
+        if expired is not None:
+            return expired
         if self.flow is None:
-            return await self._schedule_and_forward(body, request)
-        outcome = await self.flow.acquire(sheddable=priority < 0)
+            return await self._schedule_and_forward(
+                body, request, criticality, deadline_epoch)
+        from llm_d_tpu.utils.lifecycle import remaining_s
+        outcome = await self.flow.acquire(
+            sheddable=priority < 0 or criticality == "sheddable",
+            criticality=criticality,
+            max_wait_s=remaining_s(deadline_epoch))
         if outcome == "saturated":
             self.flow.metrics.flow_control_rejects.labels(
                 reason="saturated").inc()
@@ -187,16 +229,41 @@ class Gateway:
                 {"error": "saturated: sheddable request refused under "
                           "load"}, status=429)
         if outcome in ("queue_full", "timeout"):
+            # A deadline-capped queue timeout is a deadline miss, not an
+            # overload verdict — answer the honest 504.
+            expired = self._deadline_expired(criticality, deadline_epoch)
+            if expired is not None:
+                return expired
             return web.json_response(
                 {"error": f"overloaded: flow control {outcome}"},
                 status=503)
         try:
-            return await self._schedule_and_forward(body, request)
+            # Queue time may have eaten the whole budget: refuse before
+            # forwarding rather than burn an upstream slot on a request
+            # the client has already written off.
+            expired = self._deadline_expired(criticality, deadline_epoch)
+            if expired is not None:
+                return expired
+            return await self._schedule_and_forward(
+                body, request, criticality, deadline_epoch)
         finally:
             self.flow.release()
 
+    def _deadline_expired(self, criticality: str,
+                          deadline_epoch: Optional[float]
+                          ) -> Optional[web.Response]:
+        if deadline_epoch is None or time.time() <= deadline_epoch:
+            return None
+        self.scheduler.metrics.gateway_deadline_exceeded.labels(
+            criticality=criticality).inc()
+        return web.json_response(
+            {"error": "deadline exceeded"}, status=504,
+            headers={DEADLINE_EXCEEDED_HEADER: "1"})
+
     async def _schedule_and_forward(self, body: Dict,
-                                    request: web.Request
+                                    request: web.Request,
+                                    criticality: str = "standard",
+                                    deadline_epoch: Optional[float] = None
                                     ) -> web.StreamResponse:
         """Schedule, forward, and on connect-failure/5xx RE-SCHEDULE on the
         surviving replicas (bounded attempts; failed endpoints are excluded
@@ -228,6 +295,11 @@ class Gateway:
                        and e.address != addr
                        for e in self.datastore.candidates())
         for attempt in range(max_attempts):
+            # A retry after a slow failed forward may already be past the
+            # deadline — stop burning attempts on it.
+            expired = self._deadline_expired(criticality, deadline_epoch)
+            if expired is not None:
+                return expired
             try:
                 ctx = self._make_ctx(body, request)
                 ctx.excluded_endpoints = set(excluded)
@@ -266,6 +338,11 @@ class Gateway:
             fwd_headers = {k: v for k, v in result.headers.items()
                            if k != DESTINATION_HEADER}
             fwd_headers[RETRY_ATTEMPT_HEADER] = str(attempt)
+            # Lifecycle contract rides every hop: absolute deadline +
+            # SLO class (the sidecar and model server consume both).
+            fwd_headers[CRITICALITY_HEADER] = criticality
+            if deadline_epoch is not None:
+                fwd_headers[DEADLINE_ABS_HEADER] = f"{deadline_epoch:.6f}"
             url = f"{primary.url}{request.path}"
             resp = None
             attempts_made += 1

@@ -23,6 +23,7 @@ import argparse
 import asyncio
 import json
 import logging
+import signal
 import time
 import uuid as uuid_mod
 from typing import Any, Dict, List, Optional
@@ -33,6 +34,13 @@ from llm_d_tpu.engine.async_engine import AsyncEngine
 from llm_d_tpu.engine.engine import EngineConfig, EngineCore
 from llm_d_tpu.engine.request import Request
 from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.utils.config import env_float, env_int
+from llm_d_tpu.utils.lifecycle import (
+    DEADLINE_EXCEEDED_HEADER,
+    DRAINING_HEADER,
+    parse_criticality,
+    parse_deadline,
+)
 from llm_d_tpu.utils.tokenizer import get_tokenizer
 
 logger = logging.getLogger(__name__)
@@ -216,6 +224,20 @@ class ModelServer:
         # Multi-host DP: leader-side worker pool (set by main / tests).
         self.dp_pool: Optional[DPWorkerPool] = None
         self.started_at = time.time()
+        # --- lifecycle ---
+        # draining: readiness is down and new inference is refused (503)
+        # while in-flight requests complete, bounded by drain_timeout_s;
+        # stragglers past the bound are aborted (their computed full KV
+        # blocks stay in the prefix cache / host tier, so a retry after
+        # restart reuses the prefix instead of recomputing it).
+        self.draining = False
+        self._inflight = 0
+        self._drain_task: Optional[asyncio.Task] = None
+        self._exit_after_drain = False
+        self.drain_timeout_s = env_float("LLMD_DRAIN_TIMEOUT_S", 30.0)
+        # Default latency budget applied when the client sends none
+        # (0 = no default; operators cap runaway queue time fleet-wide).
+        self.deadline_default_ms = env_int("LLMD_DEADLINE_DEFAULT_MS", 0)
         if tokenizer.eos_token_id is not None:
             engine.eos_token_id = tokenizer.eos_token_id
         # Engine-side stop-string detection (finish_reason="stop" without
@@ -233,6 +255,7 @@ class ModelServer:
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/tokenize", self.tokenize)
+        app.router.add_post("/admin/drain", self.admin_drain)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -240,6 +263,16 @@ class ModelServer:
     async def _on_startup(self, app) -> None:
         await self.async_engine.start()
         self.model_loaded = True
+        try:
+            # Rolling restarts: SIGTERM flips to draining (readiness down,
+            # in-flight completing) instead of dropping work on the floor;
+            # after the bounded drain the process exits via the normal
+            # shutdown path.  Only installable on the main thread's loop —
+            # embedded/test servers skip silently.
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGTERM, self._on_sigterm)
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
 
     async def _on_cleanup(self, app) -> None:
         self.async_engine.stop()
@@ -259,6 +292,12 @@ class ModelServer:
     async def models(self, request: web.Request) -> web.Response:
         if not self.model_loaded:
             return web.json_response({"error": "model loading"}, status=503)
+        if self.draining:
+            # Readiness flips first: the gateway's scrape + drain-filter
+            # stop routing here while in-flight requests complete.
+            return web.json_response(
+                {"error": "draining"}, status=503,
+                headers={DRAINING_HEADER: "1"})
         return web.json_response({
             "object": "list",
             "data": [{"id": self.model_name, "object": "model",
@@ -278,15 +317,89 @@ class ModelServer:
         ids = self.tokenizer.encode(body.get("prompt", ""))
         return web.json_response({"tokens": ids, "count": len(ids)})
 
+    # ---------- drain (graceful restart protocol) ----------
+
+    async def admin_drain(self, request: web.Request) -> web.Response:
+        """Flip this replica to draining: readiness goes 503, new inference
+        is refused (the gateway retries on an alternate), in-flight
+        requests complete up to ``drain_timeout_s``, then stragglers are
+        aborted.  Idempotent — the deploy preStop hook and the SIGTERM
+        handler may both fire."""
+        self._begin_drain()
+        return web.json_response({
+            "status": "draining",
+            "inflight": self._inflight,
+            "timeout_s": self.drain_timeout_s,
+        })
+
+    def _on_sigterm(self) -> None:
+        logger.info("SIGTERM: draining (timeout %.1fs)", self.drain_timeout_s)
+        self._begin_drain(exit_after=True)
+
+    def _begin_drain(self, exit_after: bool = False) -> None:
+        if not self.draining:
+            self.draining = True
+            self.engine.metrics.drain_state.set(1)
+            self.engine.metrics.drain_inflight.set(self._inflight)
+            self._drain_task = asyncio.get_running_loop().create_task(
+                self._drain_loop())
+        if exit_after and not self._exit_after_drain \
+                and self._drain_task is not None:
+            # SIGTERM may land AFTER the preStop hook already started the
+            # drain: attach the exit to the existing drain instead of
+            # no-opping (which would park the process until SIGKILL).
+            self._exit_after_drain = True
+            self._drain_task.add_done_callback(
+                lambda _t: signal.raise_signal(signal.SIGINT))
+
+    async def _drain_loop(self) -> None:
+        bound = time.monotonic() + self.drain_timeout_s
+        m = self.engine.metrics
+        while time.monotonic() < bound:
+            m.drain_inflight.set(self._inflight)
+            if self._inflight == 0 \
+                    and not getattr(self.engine, "has_work", lambda: False)():
+                break
+            await asyncio.sleep(0.05)
+        # Bounded drain: abort stragglers so SIGKILL can't catch them
+        # mid-step.  Their computed full blocks are already in the prefix
+        # cache (and host/shared KV tier when configured) — the unfinished
+        # prefix state is handed back through the KV plane rather than
+        # burned.
+        stragglers = list(self.async_engine._streams)
+        for rid in stragglers:
+            logger.warning("drain timeout: aborting in-flight request %s",
+                           rid)
+            self.async_engine.abort(rid, notify=True)
+        m.drain_inflight.set(0)
+        logger.info("drain complete (%d straggler(s) aborted)",
+                    len(stragglers))
+        # When SIGTERM initiated (or joined) this drain, the done
+        # callback installed by _begin_drain re-enters aiohttp's normal
+        # shutdown path via SIGINT.
+
     # ---------- inference ----------
 
-    def _make_request(self, body: Dict[str, Any], prompt_ids: List[int]) -> Request:
+    def _make_request(self, body: Dict[str, Any], prompt_ids: List[int],
+                      headers: Optional[Dict[str, str]] = None) -> Request:
         rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
+        headers = headers or {}
+        # Deadline: absolute epoch from the gateway wins; a bare relative
+        # budget (direct client) is based here.  Epoch -> engine monotonic
+        # clock so queue time spent BEFORE this hop still counts.
+        deadline_epoch = parse_deadline(headers, body)
+        if deadline_epoch is None and self.deadline_default_ms > 0:
+            deadline_epoch = time.time() + self.deadline_default_ms / 1000.0
+        deadline = None
+        if deadline_epoch is not None:
+            deadline = time.monotonic() + (deadline_epoch - time.time())
         req = Request(
             request_id=rid,
             prompt_token_ids=prompt_ids,
             sampling=_sampling_from_body(body),
             priority=int(body.get("priority", 0)),
+            criticality=parse_criticality(headers, body),
+            deadline=deadline,
         )
         ktp = body.get("kv_transfer_params")
         if ktp:
@@ -298,11 +411,23 @@ class ModelServer:
                 req.kv_transfer_params = ktp
         return req
 
+    def _refuse_draining(self) -> Optional[web.Response]:
+        """503 for NEW inference while draining (the gateway's retry path
+        re-schedules it on an alternate replica)."""
+        if not self.draining:
+            return None
+        return web.json_response(
+            {"error": "draining: replica is shutting down"}, status=503,
+            headers={DRAINING_HEADER: "1"})
+
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
+        refused = self._refuse_draining()
+        if refused is not None:
+            return refused
         if self.dp_pool is not None:
             worker = self.dp_pool.pick(self.engine)
             if worker is not None:
@@ -321,6 +446,9 @@ class ModelServer:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
+        refused = self._refuse_draining()
+        if refused is not None:
+            return refused
         if self.dp_pool is not None:
             worker = self.dp_pool.pick(self.engine)
             if worker is not None:
@@ -402,7 +530,32 @@ class ModelServer:
 
     async def _run(self, http_req: web.Request, body: Dict[str, Any],
                    prompt_ids: List[int], chat: bool) -> web.StreamResponse:
-        req = self._make_request(body, prompt_ids)
+        try:
+            req = self._make_request(
+                body, prompt_ids,
+                {k.lower(): v for k, v in http_req.headers.items()})
+        except (TypeError, ValueError) as exc:
+            return web.json_response(
+                {"error": f"invalid request: {exc}"}, status=400)
+        if req.deadline_expired():
+            # Budget already blown (e.g. spent queueing at the gateway):
+            # refuse before burning a single engine step.
+            self.engine.metrics.inc_deadline_exceeded(req.criticality)
+            return web.json_response(
+                {"error": "deadline exceeded", "request_id": req.request_id},
+                status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})
+        self._inflight += 1
+        if self.draining:
+            self.engine.metrics.drain_inflight.set(self._inflight)
+        try:
+            return await self._run_inner(http_req, body, req, chat)
+        finally:
+            self._inflight -= 1
+            if self.draining:
+                self.engine.metrics.drain_inflight.set(self._inflight)
+
+    async def _run_inner(self, http_req: web.Request, body: Dict[str, Any],
+                         req: Request, chat: bool) -> web.StreamResponse:
         stream = bool(body.get("stream", False))
         created = int(time.time())
         # Load signals at admission = the predictor sidecars' features.
@@ -410,7 +563,7 @@ class ModelServer:
             "num_waiting": float(self.engine.scheduler.num_waiting),
             "num_running": float(self.engine.scheduler.num_running),
             "kv_usage": float(self.engine.kv_manager.usage),
-            "prompt_tokens": float(len(prompt_ids)),
+            "prompt_tokens": float(req.num_prompt_tokens),
         }
 
         if stream:
@@ -470,6 +623,13 @@ class ModelServer:
         finish_reason = final_out.finish_reason if final_out else None
         if stopped:
             finish_reason = "stop"
+        if finish_reason == "deadline" and not req.output_token_ids:
+            # Expired while queued: nothing was produced — a 504 is the
+            # honest answer.  Partial generations (evicted mid-decode)
+            # return 200 below with finish_reason "deadline".
+            return web.json_response(
+                {"error": "deadline exceeded", "request_id": req.request_id},
+                status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})
         payload = {
             "id": req.request_id,
             "object": "chat.completion" if chat else "text_completion",
@@ -516,8 +676,10 @@ class ModelServer:
         self._post_training_sample(req, arrival_feats)
         # Non-streaming: this request already left the scheduler — the
         # depth reported is everyone still queued/running behind it.
-        return web.json_response(payload, headers={
-            DPWorkerPool.DEPTH_HEADER: str(self._sched_depth())})
+        headers = {DPWorkerPool.DEPTH_HEADER: str(self._sched_depth())}
+        if finish_reason == "deadline":
+            headers[DEADLINE_EXCEEDED_HEADER] = "1"
+        return web.json_response(payload, headers=headers)
 
     def _sched_depth(self) -> int:
         """Scheduler depth (waiting + running) — the worker-side half of

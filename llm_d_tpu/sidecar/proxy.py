@@ -32,6 +32,14 @@ from aiohttp import web
 
 from llm_d_tpu.utils.config import env_float, env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
+from llm_d_tpu.utils.lifecycle import (
+    CRITICALITY_HEADER,
+    DEADLINE_ABS_HEADER,
+    DEADLINE_EXCEEDED_HEADER,
+    parse_criticality,
+    parse_deadline,
+    remaining_s,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -107,13 +115,35 @@ class RoutingSidecar:
 
         rid = request.headers.get("x-request-id",
                                   str(body.get("request_id") or ""))
+        in_headers = {k.lower(): v for k, v in request.headers.items()}
+        try:
+            deadline_epoch = parse_deadline(in_headers, body)
+            criticality = parse_criticality(in_headers, body)
+        except ValueError as exc:
+            return web.json_response(
+                {"error": f"invalid request: {exc}", "request_id": rid},
+                status=400)
+        left = remaining_s(deadline_epoch)
+        if left is not None and left <= 0:
+            # Refuse before the (possibly expensive) remote prefill: the
+            # budget is gone, no orchestration can bring it back.
+            return web.json_response(
+                {"error": "deadline exceeded", "request_id": rid},
+                status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})
+        # Lifecycle headers ride BOTH hops (prefill and local decode).
+        fwd_headers = {CRITICALITY_HEADER: criticality}
+        if deadline_epoch is not None:
+            fwd_headers[DEADLINE_ABS_HEADER] = f"{deadline_epoch:.6f}"
+        if rid:
+            fwd_headers["x-request-id"] = rid
         hint = request.headers.get(PREFILLER_HEADER) or \
             self.static_prefiller or ""
         prefillers = [p.strip() for p in hint.split(",") if p.strip()]
         local_fallback = False
         if prefillers and not body.get("kv_transfer_params"):
             decode_body = await self._prefill_with_failover(
-                request.path, body, prefillers, rid)
+                request.path, body, prefillers, rid,
+                deadline_epoch=deadline_epoch, fwd_headers=fwd_headers)
             if decode_body is None:
                 # Every prefiller is down: recompute locally on the decode
                 # pod (full local prefill — the request survives the
@@ -128,7 +158,8 @@ class RoutingSidecar:
                 body = decode_body
 
         async with self._session.post(
-                f"{self.decode_url}{request.path}", json=body) as upstream:
+                f"{self.decode_url}{request.path}", json=body,
+                headers=fwd_headers) as upstream:
             resp = await self._relay(request, upstream, request_id=rid,
                                      extra_headers=(
                                          {FALLBACK_HEADER: "local"}
@@ -137,7 +168,10 @@ class RoutingSidecar:
 
     async def _prefill_with_failover(self, path: str, body: dict,
                                      prefillers: List[str],
-                                     request_id: str) -> Optional[dict]:
+                                     request_id: str,
+                                     deadline_epoch: Optional[float] = None,
+                                     fwd_headers: Optional[dict] = None
+                                     ) -> Optional[dict]:
         """Try each prefiller in ranked order, up to ``prefill_retries + 1``
         rounds with capped exponential backoff between rounds.  Returns the
         decode body (kv_transfer_params attached) or None when every
@@ -149,9 +183,16 @@ class RoutingSidecar:
                 await asyncio.sleep(min(
                     self.prefill_backoff_s * (2 ** (rnd - 1)),
                     8 * self.prefill_backoff_s))
+            left = remaining_s(deadline_epoch)
+            if left is not None and left <= 0:
+                # Budget gone mid-failover: stop — the decode hop renders
+                # the authoritative 504.
+                return None
             for prefiller in prefillers:
                 try:
-                    out = await self._run_prefill(path, body, prefiller)
+                    out = await self._run_prefill(
+                        path, body, prefiller,
+                        deadline_epoch=deadline_epoch, headers=fwd_headers)
                     if rnd or prefiller != prefillers[0]:
                         logger.warning(
                             "prefill failover succeeded via %s "
@@ -168,7 +209,9 @@ class RoutingSidecar:
                         return None
         return None
 
-    async def _run_prefill(self, path: str, body: dict, prefiller: str) -> dict:
+    async def _run_prefill(self, path: str, body: dict, prefiller: str,
+                           deadline_epoch: Optional[float] = None,
+                           headers: Optional[dict] = None) -> dict:
         """Step 1 of the PD contract: remote prefill, returns the decode body.
 
         The prefill request mirrors the original but generates a single
@@ -182,15 +225,22 @@ class RoutingSidecar:
         prefill_body["max_tokens"] = 1
         prefill_body["kv_transfer_params"] = {"do_remote_decode": True}
         url = f"{self.scheme}://{prefiller}{path}"
+        # A request deadline caps the per-attempt budget: a prefill that
+        # cannot finish inside the remaining budget is a miss either way,
+        # so fail over (or give up) instead of sleeping past the deadline.
+        timeout_s = self.prefill_timeout_s
+        left = remaining_s(deadline_epoch)
+        if left is not None:
+            timeout_s = max(0.001, min(timeout_s, left))
         try:
             await get_injector().acheck("sidecar.prefill", key=prefiller)
             # sock_connect bound: a blackholed prefiller (dead node, SYNs
             # dropped) must cost seconds before failover, not the full
             # prefill budget (same bound as the gateway's forward path).
             async with self._session.post(
-                    url, json=prefill_body,
+                    url, json=prefill_body, headers=headers,
                     timeout=aiohttp.ClientTimeout(
-                        total=self.prefill_timeout_s,
+                        total=timeout_s,
                         sock_connect=10)) as resp:
                 if resp.status != 200:
                     # 4xx is a verdict on the REQUEST, not the prefiller:

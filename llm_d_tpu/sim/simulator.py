@@ -30,9 +30,20 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from llm_d_tpu.utils.hashing import hash_token_blocks
+from llm_d_tpu.utils.lifecycle import (
+    DEADLINE_EXCEEDED_HEADER,
+    DRAINING_HEADER,
+    parse_criticality,
+    parse_deadline,
+)
 from llm_d_tpu.utils.metrics import EngineMetrics
 
 logger = logging.getLogger(__name__)
+
+
+class DeadlineExceeded(Exception):
+    """A request's latency budget expired while it was queued for a slot
+    (the sim's analogue of the scheduler's queued-deadline rejection)."""
 
 _LOREM = ("the quick brown fox jumps over the lazy dog and runs far away "
           "into deep green woods while rain falls soft on old stone walls "
@@ -70,6 +81,10 @@ class InferenceSimulator:
         self.metrics = EngineMetrics(config.model)
         self.started_at = time.time()
         self.model_loaded = False
+        # Lifecycle mirror: draining refuses new work (503) while
+        # in-flight requests complete — the chaos suite roll-restarts an
+        # entire sim fleet against this flag.
+        self.draining = False
         self._running = 0
         self._waiting = 0
         self._blocks_used = 0          # simulated KV blocks held
@@ -94,6 +109,13 @@ class InferenceSimulator:
         usable = self.config.num_blocks
         self.metrics.kv_cache_usage_perc.set(
             min(1.0, self._blocks_used / usable if usable else 0.0))
+        if self.draining:
+            self.metrics.drain_inflight.set(self._running + self._waiting)
+
+    def set_draining(self) -> None:
+        self.draining = True
+        self.metrics.drain_state.set(1)
+        self._update_gauges()
 
     def _prefix_hit_tokens(self, token_ids: List[int]) -> int:
         hashes = hash_token_blocks(token_ids, self.config.block_size)
@@ -124,49 +146,114 @@ class InferenceSimulator:
 
     # ---------- request lifecycle ----------
 
-    async def run_request(self, prompt_ids: List[int], max_tokens: int):
-        """Yields (token_text, is_first) at the simulated rate."""
-        c = self.config
-        arrival = time.monotonic()
+    async def admit(self, prompt_ids: List[int], max_tokens: int,
+                    deadline_epoch: Optional[float] = None,
+                    criticality: str = "standard") -> Dict[str, Any]:
+        """Queue for a running slot.  Raises :class:`DeadlineExceeded`
+        when the budget expires while queued (mirrors the real
+        scheduler's queued-deadline rejection; the simulated KV blocks
+        were never held, so they "free the same step").  Returns the
+        ticket :meth:`stream_tokens` consumes."""
         self._waiting += 1
         self._update_gauges()
-        async with self._slots:
-            self._waiting -= 1
-            self._running += 1
-            n_blocks = (len(prompt_ids) + max_tokens) // c.block_size + 1
-            self._blocks_used += n_blocks
-            self._update_gauges()
+        arrival = time.monotonic()
+        try:
+            left = (None if deadline_epoch is None
+                    else deadline_epoch - time.time())
             try:
-                cached = self._prefix_hit_tokens(prompt_ids)
-                self.metrics.prefix_cache_queries.inc(len(prompt_ids))
-                if cached:
-                    self.metrics.prefix_cache_hits.inc(
-                        min(cached, len(prompt_ids)))
-                # TTFT scales down with prefix-cache hits (the signal the
-                # prefix scorers exploit).
-                miss_frac = 1.0 - min(cached, len(prompt_ids)) / max(
-                    1, len(prompt_ids))
-                await asyncio.sleep(c.ttft_ms / 1e3 * max(miss_frac, 0.1))
-                self.metrics.prompt_tokens.inc(len(prompt_ids))
-                self.metrics.time_to_first_token.observe(
-                    time.monotonic() - arrival)
-                self._store_prefix(prompt_ids)
-                for i in range(max_tokens):
-                    if i > 0:
-                        await asyncio.sleep(c.tpot_ms / 1e3)
-                        self.metrics.inter_token_latency.observe(c.tpot_ms / 1e3)
-                    word = _LOREM[(len(prompt_ids) + i) % len(_LOREM)]
-                    self.metrics.generation_tokens.inc()
-                    yield (word + " ", i == 0)
-                self.metrics.request_success.labels(
-                    model_name=self.config.model,
-                    finished_reason="length").inc()
-                self.metrics.e2e_request_latency.observe(
-                    time.monotonic() - arrival)
-            finally:
-                self._running -= 1
-                self._blocks_used -= n_blocks
-                self._update_gauges()
+                if left is not None and left <= 0:
+                    raise DeadlineExceeded()
+                if left is None:
+                    await self._slots.acquire()
+                else:
+                    await asyncio.wait_for(self._slots.acquire(), left)
+            except (asyncio.TimeoutError, DeadlineExceeded):
+                self.metrics.inc_deadline_exceeded(criticality)
+                raise DeadlineExceeded() from None
+        finally:
+            self._waiting -= 1
+            self._update_gauges()
+        self.metrics.observe_queue_wait(
+            criticality, time.monotonic() - arrival)
+        n_blocks = (len(prompt_ids) + max_tokens) // \
+            self.config.block_size + 1
+        self._running += 1
+        self._blocks_used += n_blocks
+        self._update_gauges()
+        return {"prompt_ids": prompt_ids, "max_tokens": max_tokens,
+                "deadline_epoch": deadline_epoch,
+                "criticality": criticality, "n_blocks": n_blocks,
+                "arrival": arrival, "expired": False, "released": False}
+
+    def release_ticket(self, ticket: Dict[str, Any]) -> None:
+        """Idempotent slot/block release.  ``stream_tokens`` calls this in
+        its finally; callers must ALSO call it when an admitted ticket's
+        generator might never be entered (e.g. client disconnect between
+        admission and the first token), or the sim's capacity leaks."""
+        if ticket["released"]:
+            return
+        ticket["released"] = True
+        self._running -= 1
+        self._blocks_used -= ticket["n_blocks"]
+        self._slots.release()
+        self._update_gauges()
+
+    async def stream_tokens(self, ticket: Dict[str, Any]):
+        """Yields (token_text, is_first) at the simulated rate for an
+        admitted ticket; releases the slot + blocks on exit.  A deadline
+        that expires mid-generation truncates at the next token boundary
+        (``ticket["expired"]`` turns True) — the real engine's
+        step-boundary eviction."""
+        c = self.config
+        prompt_ids = ticket["prompt_ids"]
+        arrival = ticket["arrival"]
+        deadline_epoch = ticket["deadline_epoch"]
+        try:
+            cached = self._prefix_hit_tokens(prompt_ids)
+            self.metrics.prefix_cache_queries.inc(len(prompt_ids))
+            if cached:
+                self.metrics.prefix_cache_hits.inc(
+                    min(cached, len(prompt_ids)))
+            # TTFT scales down with prefix-cache hits (the signal the
+            # prefix scorers exploit).
+            miss_frac = 1.0 - min(cached, len(prompt_ids)) / max(
+                1, len(prompt_ids))
+            await asyncio.sleep(c.ttft_ms / 1e3 * max(miss_frac, 0.1))
+            self.metrics.prompt_tokens.inc(len(prompt_ids))
+            self.metrics.time_to_first_token.observe(
+                time.monotonic() - arrival)
+            self._store_prefix(prompt_ids)
+            reason = "length"
+            for i in range(ticket["max_tokens"]):
+                if i > 0:
+                    await asyncio.sleep(c.tpot_ms / 1e3)
+                    self.metrics.inter_token_latency.observe(c.tpot_ms / 1e3)
+                if deadline_epoch is not None \
+                        and time.time() > deadline_epoch:
+                    ticket["expired"] = True
+                    reason = "deadline"
+                    self.metrics.inc_deadline_exceeded(
+                        ticket["criticality"])
+                    break
+                word = _LOREM[(len(prompt_ids) + i) % len(_LOREM)]
+                self.metrics.generation_tokens.inc()
+                yield (word + " ", i == 0)
+            self.metrics.request_success.labels(
+                model_name=self.config.model,
+                finished_reason=reason).inc()
+            self.metrics.e2e_request_latency.observe(
+                time.monotonic() - arrival)
+        finally:
+            self.release_ticket(ticket)
+
+    async def run_request(self, prompt_ids: List[int], max_tokens: int,
+                          deadline_epoch: Optional[float] = None,
+                          criticality: str = "standard"):
+        """Admit + stream in one call (legacy surface)."""
+        ticket = await self.admit(prompt_ids, max_tokens,
+                                  deadline_epoch, criticality)
+        async for item in self.stream_tokens(ticket):
+            yield item
 
 
 class SimServer:
@@ -182,8 +269,19 @@ class SimServer:
         app.router.add_get("/metrics", self.metrics)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/admin/drain", self.admin_drain)
         app.on_startup.append(self._on_startup)
         return app
+
+    async def admin_drain(self, request: web.Request) -> web.Response:
+        """Same drain protocol as the real model server: readiness flips,
+        new inference 503s, in-flight completes (the caller owns the
+        bounded wait)."""
+        self.sim.set_draining()
+        return web.json_response({
+            "status": "draining",
+            "inflight": self.sim._running + self.sim._waiting,
+        })
 
     async def _on_startup(self, app) -> None:
         async def load():
@@ -197,6 +295,9 @@ class SimServer:
     async def models(self, request: web.Request) -> web.Response:
         if not self.sim.model_loaded:
             return web.json_response({"error": "model loading"}, status=503)
+        if self.sim.draining:
+            return web.json_response({"error": "draining"}, status=503,
+                                     headers={DRAINING_HEADER: "1"})
         return web.json_response({
             "object": "list",
             "data": [{"id": self.sim.config.model, "object": "model",
@@ -219,6 +320,22 @@ class SimServer:
             body = await http_req.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
+        rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
+        if self.sim.draining:
+            # Same contract as the real server: new inference 503s while
+            # draining; the gateway's retry path re-schedules elsewhere.
+            return web.json_response(
+                {"error": "draining: replica is shutting down",
+                 "request_id": rid},
+                status=503, headers={DRAINING_HEADER: "1"})
+        in_headers = {k.lower(): v for k, v in http_req.headers.items()}
+        try:
+            deadline_epoch = parse_deadline(in_headers, body)
+            criticality = parse_criticality(in_headers, body)
+        except ValueError as exc:
+            return web.json_response(
+                {"error": f"invalid request: {exc}", "request_id": rid},
+                status=400)
         if chat:
             prompt = "".join(m.get("content", "")
                              for m in body.get("messages", []))
@@ -229,19 +346,33 @@ class SimServer:
         prompt_ids = self.sim._tokenize(str(prompt))
         max_tokens = int(body.get("max_tokens",
                                   body.get("max_completion_tokens", 16)))
-        rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
         created = int(time.time())
         stream = bool(body.get("stream", False))
         model = self.sim.config.model
+
+        try:
+            # Admission BEFORE the stream is prepared so a queued-deadline
+            # expiry can still answer an honest 504.
+            ticket = await self.sim.admit(prompt_ids, max_tokens,
+                                          deadline_epoch, criticality)
+        except DeadlineExceeded:
+            return web.json_response(
+                {"error": "deadline exceeded", "request_id": rid},
+                status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})
 
         if stream:
             resp = web.StreamResponse(headers={
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache"})
-            await resp.prepare(http_req)
+            try:
+                await resp.prepare(http_req)
+            except BaseException:
+                # Client gone before the generator ever ran: its finally
+                # can't fire, so release here or the slot leaks.
+                self.sim.release_ticket(ticket)
+                raise
             i = 0
-            async for text, _first in self.sim.run_request(
-                    prompt_ids, max_tokens):
+            async for text, _first in self.sim.stream_tokens(ticket):
                 i += 1
                 finished = i == max_tokens
                 choice: Dict[str, Any] = {
@@ -262,9 +393,15 @@ class SimServer:
             return resp
 
         parts: List[str] = []
-        async for text, _first in self.sim.run_request(prompt_ids, max_tokens):
+        async for text, _first in self.sim.stream_tokens(ticket):
             parts.append(text)
         full = "".join(parts)
+        if ticket["expired"] and not parts:
+            # Parity with the real server: nothing generated before the
+            # budget blew -> an honest 504, not a 200 with empty text.
+            return web.json_response(
+                {"error": "deadline exceeded", "request_id": rid},
+                status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})
         ktp = body.get("kv_transfer_params") or {}
         payload = {
             "id": rid,
@@ -273,7 +410,8 @@ class SimServer:
             "model": model,
             "choices": [{
                 "index": 0,
-                "finish_reason": "length",
+                "finish_reason": "deadline" if ticket["expired"]
+                else "length",
                 **({"message": {"role": "assistant", "content": full}}
                    if chat else {"text": full}),
             }],
@@ -296,7 +434,10 @@ class SimServer:
                 "remote_host": "sim", "remote_port": 0, "uuid": rid,
                 "sim": True,
             }
-        return web.json_response(payload)
+        return web.json_response(
+            payload,
+            headers=({DEADLINE_EXCEEDED_HEADER: "1"}
+                     if ticket["expired"] else {}))
 
 
 def build_sim_server(config: Optional[SimConfig] = None,

@@ -104,6 +104,11 @@ class TpuConnector:
         # a set entry forever).
         self._aborted: set = set()
         self._pending_ids: set = set()
+        # request_id -> (host, port, uuid) for pulls that may still hold a
+        # PRODUCER pin: cancellation (abort / deadline expiry) sends the
+        # release so the producer's blocks free immediately instead of
+        # waiting out its pin timeout.
+        self._pending_params: Dict[str, Tuple[str, int, str]] = {}
 
     # ------------------------------------------------------------------
     # producer side
@@ -143,6 +148,12 @@ class TpuConnector:
         with self._inflight_mu:
             self._inflight += 1
             self._pending_ids.add(req.request_id)
+            try:
+                self._pending_params[req.request_id] = (
+                    str(params["remote_host"]), int(params["remote_port"]),
+                    str(params.get("uuid", req.request_id)))
+            except (KeyError, TypeError, ValueError):
+                pass    # malformed params fail in the fetch worker anyway
         threading.Thread(
             target=self._fetch_worker, args=(req, params),
             name=f"kv-pull-{req.request_id[:8]}", daemon=True).start()
@@ -198,10 +209,35 @@ class TpuConnector:
         self._loaded.put((req, blob, error, time.perf_counter() - t0))
 
     def abort(self, request_id: str) -> None:
-        """Mark an in-flight pull's request aborted (dropped at poll)."""
+        """Mark an in-flight pull's request aborted (dropped at poll) and
+        release the PRODUCER's pinned blocks eagerly — a cancelled or
+        deadline-expired consumer must propagate P->D, or the producer's
+        cache shrinks until its pin timeout fires."""
         with self._inflight_mu:
-            if request_id in self._pending_ids:
-                self._aborted.add(request_id)
+            if request_id not in self._pending_ids:
+                return
+            self._aborted.add(request_id)
+            remote = self._pending_params.get(request_id)
+        if remote is not None:
+            self._release_remote(request_id, remote)
+
+    def _release_remote(self, request_id: str,
+                        remote: Tuple[str, int, str]) -> None:
+        """Best-effort producer release off the engine thread (the
+        producer's pin timeout is the backstop when this fails)."""
+        host, port, uuid = remote
+
+        def _release():
+            try:
+                transport.release(host, port, uuid,
+                                  timeout_ms=self.config.timeout_ms)
+            except (transport.TransferError, OSError, ValueError) as e:
+                logger.warning(
+                    "cancel-release for %s failed (%s); producer pin "
+                    "timeout will reclaim", request_id, e)
+        threading.Thread(target=_release,
+                         name=f"kv-cancel-{request_id[:8]}",
+                         daemon=True).start()
 
     def has_pending(self) -> bool:
         with self._inflight_mu:
@@ -232,6 +268,7 @@ class TpuConnector:
             with self._inflight_mu:
                 self._inflight -= 1
                 self._pending_ids.discard(req.request_id)
+                self._pending_params.pop(req.request_id, None)
             if req.request_id in self._aborted:
                 self._aborted.discard(req.request_id)
                 req.state = RequestState.FINISHED_ABORTED
@@ -254,6 +291,16 @@ class TpuConnector:
         for req, blob in ready:
             with self._inflight_mu:
                 self._pending_ids.discard(req.request_id)
+            if req.deadline_expired():
+                # Budget blew while the KV slab was in flight / parked:
+                # drop before allocating a single local block.  The
+                # producer's pin was already released post-fetch.
+                req.state = RequestState.FINISHED_DEADLINE
+                engine.metrics.inc_deadline_exceeded(req.criticality)
+                outputs.append(RequestOutput(
+                    req.request_id, [], True,
+                    finish_reason=RequestState.FINISHED_DEADLINE.value))
+                continue
             out = self._admit(engine, req, blob)   # re-adds if retried
             if out is not None:
                 outputs.append(out)

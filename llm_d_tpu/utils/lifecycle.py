@@ -1,0 +1,120 @@
+"""Request-lifecycle contract shared by every hop: deadlines + SLO classes.
+
+The reference stack treats request lifecycle as a first-class contract —
+the GAIE flow-control queue sheds by criticality and saturation (SURVEY
+§L4), and P/D-Serve (arxiv 2408.08147) shows disaggregated serving at
+scale lives or dies on deadline-aware admission and smooth instance
+rollover.  This module is the ONE place the wire contract is defined so
+gateway, sidecar, model server, simulator, and load generator cannot
+drift apart:
+
+  x-llmd-deadline-ms     relative latency budget in ms (client-facing);
+                         the OpenAI-body ``timeout`` field (seconds) is
+                         an accepted alias.
+  x-llmd-deadline        ABSOLUTE unix-epoch deadline in seconds,
+                         stamped by the first hop that sees the relative
+                         budget and propagated verbatim after that
+                         (re-deriving relative budgets per hop would
+                         double-count queueing time).
+  x-llmd-deadline-exceeded  response marker: the request was refused or
+                         evicted because its deadline passed (rides on
+                         the 504).
+  x-llmd-criticality     SLO class: critical | standard | sheddable
+                         (body field ``criticality`` is the alias).
+  x-llmd-draining        response marker: the replica refused new work
+                         because it is draining.
+
+Criticality maps to priority *tiers* consumed by the engine scheduler's
+``(priority, arrival)`` queue order and by preemption victim selection:
+critical outranks standard outranks sheddable, and a request's own
+``priority`` int breaks ties within its class.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+CRITICALITY_HEADER = "x-llmd-criticality"
+DEADLINE_MS_HEADER = "x-llmd-deadline-ms"
+DEADLINE_ABS_HEADER = "x-llmd-deadline"
+DEADLINE_EXCEEDED_HEADER = "x-llmd-deadline-exceeded"
+DRAINING_HEADER = "x-llmd-draining"
+
+CRITICALITY_CRITICAL = "critical"
+CRITICALITY_STANDARD = "standard"
+CRITICALITY_SHEDDABLE = "sheddable"
+CRITICALITIES = (CRITICALITY_CRITICAL, CRITICALITY_STANDARD,
+                 CRITICALITY_SHEDDABLE)
+
+# Engine-side tier per class (lower = scheduled first, preempted last —
+# the scheduler's existing "lower priority value = more important" order).
+CRITICALITY_TIERS = {
+    CRITICALITY_CRITICAL: -1,
+    CRITICALITY_STANDARD: 0,
+    CRITICALITY_SHEDDABLE: 1,
+}
+
+
+def parse_criticality(headers: Dict[str, str],
+                      body: Optional[Dict[str, Any]] = None) -> str:
+    """Criticality class from lowercased headers / body; default standard.
+
+    Raises ValueError on an unknown class — a typo'd criticality must
+    surface as a 400, not silently serve at the wrong tier.
+    """
+    raw = headers.get(CRITICALITY_HEADER)
+    if raw is None and body is not None:
+        raw = body.get("criticality")
+    if raw is None or raw == "":
+        return CRITICALITY_STANDARD
+    value = str(raw).strip().lower()
+    if value not in CRITICALITIES:
+        raise ValueError(
+            f"unknown criticality {raw!r} (expected one of "
+            f"{'/'.join(CRITICALITIES)})")
+    return value
+
+
+def parse_deadline(headers: Dict[str, str],
+                   body: Optional[Dict[str, Any]] = None,
+                   now: Optional[float] = None) -> Optional[float]:
+    """Absolute unix-epoch deadline for this request, or None.
+
+    Resolution order: an already-propagated absolute header wins (later
+    hops must not re-base it), then the relative ms header, then the
+    OpenAI-body ``timeout`` seconds alias.  Raises ValueError on a
+    malformed or non-positive budget (client error -> 400).
+    """
+    raw_abs = headers.get(DEADLINE_ABS_HEADER)
+    if raw_abs is not None:
+        try:
+            return float(raw_abs)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"invalid {DEADLINE_ABS_HEADER}: {raw_abs!r}") \
+                from e
+    raw_ms = headers.get(DEADLINE_MS_HEADER)
+    if raw_ms is None and body is not None:
+        timeout = body.get("timeout")
+        if timeout is not None:
+            try:
+                raw_ms = float(timeout) * 1000.0
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"invalid timeout: {timeout!r}") from e
+    if raw_ms is None:
+        return None
+    try:
+        budget_ms = float(raw_ms)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"invalid {DEADLINE_MS_HEADER}: {raw_ms!r}") from e
+    if budget_ms <= 0:
+        raise ValueError(f"deadline budget must be > 0, got {budget_ms}")
+    return (now if now is not None else time.time()) + budget_ms / 1000.0
+
+
+def remaining_s(deadline_epoch: Optional[float],
+                now: Optional[float] = None) -> Optional[float]:
+    """Seconds left until an epoch deadline (may be negative); None = none."""
+    if deadline_epoch is None:
+        return None
+    return deadline_epoch - (now if now is not None else time.time())

@@ -103,6 +103,37 @@ class EngineMetrics:
         self.kv_shared_tier_misses = counter(
             "llmd_tpu:kv_shared_tier_misses_total",
             "Shared-tier lookups that missed on every peer.")
+        # --- lifecycle (deadlines / SLO classes / drain) ---
+        # Criticality-labeled: per-class queueing and deadline losses are
+        # the SLO dashboard's primary signals (a sheddable-only miss rate
+        # under overload is healthy; a critical one is an incident).
+        self._queue_wait = Histogram(
+            "llmd_tpu:request_queue_wait_seconds",
+            "Arrival-to-first-schedule wait, by criticality class.",
+            ["model_name", "criticality"], buckets=_TIME_BUCKETS,
+            registry=self.registry)
+        self._deadline_exceeded = Counter(
+            "llmd_tpu:deadline_exceeded_total",
+            "Requests refused or evicted after their deadline passed, "
+            "by criticality class.",
+            ["model_name", "criticality"], registry=self.registry)
+        self.drain_inflight = gauge(
+            "llmd_tpu:drain_inflight",
+            "In-flight requests still completing while this replica "
+            "drains (0 when not draining or drained).")
+        self.drain_state = gauge(
+            "llmd_tpu:drain_state",
+            "1 while this replica is draining (readiness down, in-flight "
+            "completing); the EPP's drain-filter keys on this.")
+
+    def observe_queue_wait(self, criticality: str, seconds: float) -> None:
+        self._queue_wait.labels(
+            model_name=self.model_name, criticality=criticality).observe(
+            seconds)
+
+    def inc_deadline_exceeded(self, criticality: str) -> None:
+        self._deadline_exceeded.labels(
+            model_name=self.model_name, criticality=criticality).inc()
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -164,6 +195,12 @@ class EppMetrics:
             "llmd_tpu:gateway_retry_exhausted_total",
             "Requests that failed after the full retry budget.",
             registry=self.registry)
+        # Lifecycle: deadline refusals at the gateway (expired before or
+        # while queued in flow control) by criticality class.
+        self.gateway_deadline_exceeded = Counter(
+            "llmd_tpu:gateway_deadline_exceeded_total",
+            "Requests 504'd at the gateway because their deadline passed.",
+            ["criticality"], registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

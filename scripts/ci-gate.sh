@@ -9,9 +9,12 @@ python -m compileall -q llm_d_tpu tests scripts bench.py __graft_entry__.py
 python scripts/lint-envvars.py
 python scripts/lint-dockerfile.py
 for f in scripts/*.sh docs/monitoring/scripts/*.sh; do bash -n "$f"; done
-# Resilience gate first, fail-fast (injected fault schedules against the
-# sim stack + tiny engines; docs/resilience.md): a green happy path with
-# a broken failure path must not merge.  The full tier then skips it so
-# the suite runs exactly once.
+# Resilience + lifecycle gates first, fail-fast (injected fault schedules
+# against the sim stack + tiny engines; deadline/SLO-class/drain contract;
+# docs/resilience.md): a green happy path with a broken failure or
+# lifecycle path must not merge.  The full tier then skips them so each
+# suite runs exactly once.
 python -m pytest tests/test_chaos.py -q
-python -m pytest tests/ --ignore=tests/test_chaos.py
+python -m pytest tests/test_lifecycle.py -q
+python -m pytest tests/ --ignore=tests/test_chaos.py \
+    --ignore=tests/test_lifecycle.py

@@ -15,6 +15,9 @@ Examples:
       --slo-ttft-ms 200 --error-rate 0.1
   python scripts/generate_load.py --url http://gw:8000 --qps 10 \
       --faults malformed:0.1,abort:0.05,timeout:0.02   # chaos traffic
+  python scripts/generate_load.py --url http://gw:8000 --deadline-ms 800 \
+      --criticality-mix critical:0.2,standard:0.6,sheddable:0.2
+      # lifecycle traffic: per-class p50/p99 + deadline-miss rate
 
 Client-side fault kinds (--faults kind:rate[,kind:rate...], mirroring the
 reference error-injection load script):
@@ -37,8 +40,24 @@ WORDS = ("tpu mesh shard flash ring latent expert router block cache "
          "prefill decode gateway").split()
 
 
+def pick_criticality(mix: list, rng: random.Random) -> str:
+    """Weighted class draw from the --criticality-mix distribution."""
+    r = rng.random() * sum(w for _, w in mix)
+    for cls, w in mix:
+        r -= w
+        if r < 0:
+            return cls
+    return mix[-1][0]
+
+
 def make_body(args, rng: random.Random) -> tuple:
     headers = {}
+    criticality = "standard"
+    if args.criticality_list:
+        criticality = pick_criticality(args.criticality_list, rng)
+        headers["x-llmd-criticality"] = criticality
+    if args.deadline_ms > 0:
+        headers["x-llmd-deadline-ms"] = str(args.deadline_ms)
     if args.shape == "prefix":
         group = rng.randrange(args.prefix_groups)
         prompt = (f"shared-prefix-{group} " * args.prefix_len
@@ -51,11 +70,31 @@ def make_body(args, rng: random.Random) -> tuple:
         headers["x-prediction-based-scheduling"] = "true"
         headers["x-slo-ttft-ms"] = str(args.slo_ttft_ms)
         headers["x-slo-tpot-ms"] = str(args.slo_tpot_ms)
-        if rng.random() < 0.3:
+        if not args.criticality_list and rng.random() < 0.3:
             body["priority"] = -1              # sheddable tier
     if rng.random() < args.error_rate:
         body = {"prompt": None, "max_tokens": "boom"}   # error traffic
-    return body, headers
+    return body, headers, criticality
+
+
+def parse_criticality_mix(spec: str) -> list:
+    """"class:weight[,class:weight...]" -> [(class, weight)]; bad entries
+    dropped (the load tool must not die on a typo mid-campaign)."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        cls, _, weight = entry.partition(":")
+        cls = cls.strip()
+        if cls not in ("critical", "standard", "sheddable"):
+            print(f"--criticality-mix: dropping unknown class {entry!r}")
+            continue
+        try:
+            out.append((cls, float(weight or 1.0)))
+        except ValueError:
+            print(f"--criticality-mix: dropping malformed entry {entry!r}")
+    return out
 
 
 def parse_faults(spec: str) -> dict:
@@ -81,8 +120,11 @@ def pick_fault(faults: dict, rng: random.Random):
 
 
 async def one_request(session, args, rng, stats) -> None:
-    body, headers = make_body(args, rng)
+    body, headers, criticality = make_body(args, rng)
     fault = pick_fault(args.fault_map, rng)
+    cls = stats.setdefault("per_class", {}).setdefault(
+        criticality, {"latencies": [], "deadline_miss": 0, "requests": 0})
+    cls["requests"] += 1
     t0 = time.perf_counter()
     try:
         if fault == "malformed":
@@ -105,9 +147,14 @@ async def one_request(session, args, rng, stats) -> None:
                                     headers=headers, **kw) as resp:
                 await resp.read()
                 stats[resp.status] = stats.get(resp.status, 0) + 1
+                if resp.status == 504 or resp.headers.get(
+                        "x-llmd-deadline-exceeded"):
+                    cls["deadline_miss"] += 1
     except Exception:
         stats["error"] = stats.get("error", 0) + 1
-    stats.setdefault("latencies", []).append(time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    stats.setdefault("latencies", []).append(dt)
+    cls["latencies"].append(dt)
 
 
 async def run(args) -> None:
@@ -125,15 +172,30 @@ async def run(args) -> None:
             await asyncio.sleep(interval)
         if pending:
             await asyncio.gather(*pending, return_exceptions=True)
+    def pct(sorted_lats, q):
+        return (sorted_lats[min(int(q * len(sorted_lats)),
+                                len(sorted_lats) - 1)]
+                if sorted_lats else 0.0)
+
     lats = sorted(stats.pop("latencies", []))
-    p = (lambda q: lats[min(int(q * len(lats)), len(lats) - 1)]
-         if lats else 0.0)
+    per_class = {}
+    for cls, c in stats.pop("per_class", {}).items():
+        cl = sorted(c["latencies"])
+        per_class[cls] = {
+            "requests": c["requests"],
+            "latency_p50_s": round(pct(cl, 0.5), 4),
+            "latency_p99_s": round(pct(cl, 0.99), 4),
+            "deadline_miss_rate": round(
+                c["deadline_miss"] / c["requests"], 4)
+            if c["requests"] else 0.0,
+        }
     print(json.dumps({
         "requests": sum(v for v in stats.values()),
         "status_counts": stats,
-        "latency_p50_s": round(p(0.5), 4),
-        "latency_p90_s": round(p(0.9), 4),
-        "latency_p99_s": round(p(0.99), 4),
+        "latency_p50_s": round(pct(lats, 0.5), 4),
+        "latency_p90_s": round(pct(lats, 0.9), 4),
+        "latency_p99_s": round(pct(lats, 0.99), 4),
+        "per_class": per_class,
     }))
 
 
@@ -153,6 +215,15 @@ def main() -> None:
     ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
     ap.add_argument("--slo-tpot-ms", type=float, default=50.0)
     ap.add_argument("--error-rate", type=float, default=0.0)
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request latency budget sent as "
+                         "x-llmd-deadline-ms (0 = no deadline); the "
+                         "summary reports per-class deadline-miss rate")
+    ap.add_argument("--criticality-mix", default="",
+                    help="SLO-class traffic mix, class:weight[,...] over "
+                         "critical/standard/sheddable, e.g. "
+                         "critical:0.2,standard:0.6,sheddable:0.2; sent "
+                         "as x-llmd-criticality")
     ap.add_argument("--faults", default="",
                     help="client-side fault mix, kind:rate[,kind:rate...]; "
                          "kinds: malformed, abort, timeout (see module "
@@ -160,6 +231,7 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
     args.fault_map = parse_faults(args.faults)
+    args.criticality_list = parse_criticality_mix(args.criticality_mix)
     asyncio.run(run(args))
 
 

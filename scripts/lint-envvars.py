@@ -23,6 +23,11 @@ PREFIXES = ("LLMD_", "LWS_")
 READ_RE = re.compile(
     r"environ(?:\.get\(|\[)\s*\"((?:%s)[A-Z0-9_]+)\"" %
     "|".join(PREFIXES))
+# The config helpers (env_int / env_float, invalid-value fallback) are the
+# blessed way to read a knob — their call sites ARE reads, and a knob read
+# only through them must still be documented.
+HELPER_RE = re.compile(
+    r"env_(?:int|float)\(\s*\"((?:%s)[A-Z0-9_]+)\"" % "|".join(PREFIXES))
 DOC_RE = re.compile(r"^\|\s*`((?:%s)[A-Z0-9_]+)`" % "|".join(PREFIXES),
                     re.M)
 YAML_ENV_RE = re.compile(r"name:\s*((?:%s)[A-Z0-9_]+)" % "|".join(PREFIXES))
@@ -30,8 +35,14 @@ YAML_ENV_RE = re.compile(r"name:\s*((?:%s)[A-Z0-9_]+)" % "|".join(PREFIXES))
 
 def main() -> int:
     read = set()
-    for path in (REPO / "llm_d_tpu").rglob("*.py"):
-        read |= set(READ_RE.findall(path.read_text()))
+    # scripts/ ships operator tooling (load generator, benches): a knob
+    # read there is as load-bearing as one read in the package.
+    sources = list((REPO / "llm_d_tpu").rglob("*.py")) \
+        + list((REPO / "scripts").glob("*.py"))
+    for path in sources:
+        text = path.read_text()
+        read |= set(READ_RE.findall(text))
+        read |= set(HELPER_RE.findall(text))
     # The LWS contract enters through a dict parameter in mesh.py; catch
     # plain-string reads too.
     for path in (REPO / "llm_d_tpu").rglob("*.py"):

@@ -595,6 +595,208 @@ def test_peer_fetch_faults_degrade_to_recompute(inject):
 
 
 # ---------------------------------------------------------------------------
+# lifecycle chaos: rolling restart under load + class-aware overload shed
+# ---------------------------------------------------------------------------
+
+def test_rolling_restart_drain_zero_client_failures():
+    """Acceptance scenario: an 8-replica sim fleet behind the gateway is
+    roll-restarted one replica at a time under sustained load — drain
+    (readiness flips, drain-filter excludes, in-flight completes), kill,
+    restart, rejoin — with ZERO client-visible failures.  Races between
+    the drain POST and the scrape are covered by the 503-from-draining
+    retry path."""
+    import aiohttp
+
+    from llm_d_tpu.epp.service import build_gateway
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    async def run():
+        n = 8
+        ports = [free_port() for _ in range(n)]
+        sims: list = [None] * n                   # (runner, server) pairs
+
+        async def start_sim(i):
+            srv = build_sim_server(SimConfig(
+                model=f"sim-{i}", ttft_ms=1.0, tpot_ms=0.2))
+            return (await _start_app(srv.build_app(), ports[i]), srv)
+
+        for i in range(n):
+            sims[i] = await start_sim(i)
+        endpoints = [EndpointState(address=f"127.0.0.1:{p}") for p in ports]
+        gw = build_gateway(endpoints, scrape_interval_s=0.03,
+                           retry_attempts=3)
+        gw_port = free_port()
+        gw_runner = await _start_app(gw.build_app(), gw_port)
+        url = f"http://127.0.0.1:{gw_port}/v1/completions"
+        statuses: list = []
+        stop = asyncio.Event()
+
+        async def load_worker(sess, wid):
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    async with sess.post(url, json={
+                            "prompt": f"roll {wid} {i} tail",
+                            "max_tokens": 3}) as r:
+                        await r.read()
+                        statuses.append(r.status)
+                except asyncio.TimeoutError:
+                    statuses.append("hang")
+                except aiohttp.ClientError as e:
+                    statuses.append(f"error:{type(e).__name__}")
+                await asyncio.sleep(0.01)
+
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=15)) as sess:
+                for _ in range(100):
+                    if all(e.ready for e in gw.datastore.candidates()):
+                        break
+                    await asyncio.sleep(0.05)
+                assert all(e.ready for e in gw.datastore.candidates())
+                workers = [asyncio.create_task(load_worker(sess, w))
+                           for w in range(4)]
+                try:
+                    for i in range(n):
+                        addr = endpoints[i].address
+                        async with sess.post(
+                                f"http://{addr}/admin/drain") as r:
+                            assert r.status == 200
+                        sim = sims[i][1].sim
+                        # Wait until the EPP sees the drain AND the
+                        # replica's in-flight work hits zero.
+                        for _ in range(300):
+                            ep = gw.datastore.endpoints.get(addr)
+                            if ep is not None and ep.draining \
+                                    and sim._running + sim._waiting == 0:
+                                break
+                            await asyncio.sleep(0.02)
+                        assert gw.datastore.endpoints[addr].draining, \
+                            f"gateway never saw replica {i} draining"
+                        assert sim._running + sim._waiting == 0, \
+                            f"replica {i} still had in-flight work"
+                        # Kill + restart ("the pod is replaced").
+                        await sims[i][0].cleanup()
+                        sims[i] = await start_sim(i)
+                        for _ in range(300):
+                            ep = gw.datastore.endpoints.get(addr)
+                            if ep is not None and ep.ready \
+                                    and not ep.draining:
+                                break
+                            await asyncio.sleep(0.02)
+                        assert gw.datastore.endpoints[addr].ready
+                finally:
+                    stop.set()
+                    await asyncio.gather(*workers,
+                                         return_exceptions=True)
+            assert len(statuses) > n, "load generator barely ran"
+            bad = [s for s in statuses if s != 200]
+            assert not bad, (f"client-visible failures during rolling "
+                             f"restart: {bad[:10]} "
+                             f"({len(bad)}/{len(statuses)})")
+        finally:
+            for pair in sims:
+                try:
+                    await pair[0].cleanup()
+                except Exception:
+                    pass
+            await gw_runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_overload_sheds_only_sheddable_class():
+    """Seeded overload: with one upstream slot saturated, sheddable
+    requests 429 immediately while every critical and standard request
+    completes 200 — only the sheddable class is shed.  The critical
+    queue reserve also admits a critical request past a full standard
+    queue."""
+    import aiohttp
+
+    from llm_d_tpu.epp.service import build_gateway
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    async def run():
+        sim_port = free_port()
+        srv = build_sim_server(SimConfig(
+            model="sim", ttft_ms=150.0, tpot_ms=0.2))
+        runners = [await _start_app(srv.build_app(), sim_port)]
+        gw = build_gateway(
+            [EndpointState(address=f"127.0.0.1:{sim_port}")],
+            scrape_interval_s=0.05,
+            max_inflight=1, max_queue=8, queue_timeout_s=10.0)
+        gw_port = free_port()
+        runners.append(await _start_app(gw.build_app(), gw_port))
+        url = f"http://127.0.0.1:{gw_port}/v1/completions"
+        try:
+            async with aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(
+                    total=20)) as sess:
+                for _ in range(100):
+                    if all(e.ready for e in gw.datastore.candidates()):
+                        break
+                    await asyncio.sleep(0.05)
+
+                async def post(criticality):
+                    async with sess.post(url, json={
+                            "prompt": f"overload {criticality}",
+                            "max_tokens": 2},
+                            headers={"x-llmd-criticality":
+                                     criticality}) as r:
+                        await r.read()
+                        return r.status
+
+                hog = asyncio.create_task(post("standard"))
+                await asyncio.sleep(0.05)       # slot taken, sim is slow
+                others = [asyncio.create_task(post(c)) for c in
+                          ["critical"] * 2 + ["standard"] * 4]
+                await asyncio.sleep(0.05)       # all queued behind the hog
+                sheds = [await post("sheddable") for _ in range(3)]
+                assert sheds == [429, 429, 429], sheds
+                results = await asyncio.gather(hog, *others)
+                assert results == [200] * 7, results
+
+                # Critical queue reserve: a full standard queue still
+                # admits critical (max_queue=1 here; reserve default 8).
+                gw2 = build_gateway(
+                    [EndpointState(address=f"127.0.0.1:{sim_port}")],
+                    scrape_interval_s=0.05,
+                    max_inflight=1, max_queue=1, queue_timeout_s=10.0)
+                gw2_port = free_port()
+                runners.append(await _start_app(gw2.build_app(), gw2_port))
+                url2 = f"http://127.0.0.1:{gw2_port}/v1/completions"
+                for _ in range(100):
+                    if all(e.ready for e in gw2.datastore.candidates()):
+                        break
+                    await asyncio.sleep(0.05)
+
+                async def post2(criticality):
+                    async with sess.post(url2, json={
+                            "prompt": f"reserve {criticality}",
+                            "max_tokens": 2},
+                            headers={"x-llmd-criticality":
+                                     criticality}) as r:
+                        await r.read()
+                        return r.status
+
+                hog2 = asyncio.create_task(post2("standard"))
+                await asyncio.sleep(0.05)
+                queued = asyncio.create_task(post2("standard"))
+                await asyncio.sleep(0.05)       # standard queue now full
+                overflow = await post2("standard")
+                assert overflow == 503, overflow     # queue_full
+                crit_task = asyncio.create_task(post2("critical"))
+                await asyncio.sleep(0.05)
+                results2 = await asyncio.gather(hog2, queued, crit_task)
+                assert results2 == [200, 200, 200], results2
+        finally:
+            for r in runners:
+                await r.cleanup()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
 # engine death: simulated step crash must fail streams, never hang them
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,420 @@
+"""Request lifecycle: deadline propagation, SLO classes, graceful drain.
+
+Covers the wire contract (`llm_d_tpu.utils.lifecycle`), the model server's
+deadline 504 / drain protocol, the engine's deadline metrics + block
+accounting, the P->D cancellation release, and the sim mirror the chaos
+suite drives.  All CPU, tier-1 safe.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+import requests
+
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+from llm_d_tpu.engine.request import Request, RequestState
+from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.transfer import KVConnectorConfig, TpuConnector
+from llm_d_tpu.utils.faultinject import FaultInjector, install, reset
+from llm_d_tpu.utils.lifecycle import (
+    CRITICALITY_HEADER,
+    DEADLINE_ABS_HEADER,
+    DEADLINE_EXCEEDED_HEADER,
+    DEADLINE_MS_HEADER,
+    DRAINING_HEADER,
+    parse_criticality,
+    parse_deadline,
+)
+
+ENGINE_KW = dict(model="tiny", block_size=4, num_blocks=64, max_num_seqs=8,
+                 max_num_batched_tokens=64, min_token_bucket=16,
+                 min_seq_bucket=4)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def greedy_req(rid, prompt, n=4, **kw):
+    return Request(request_id=rid, prompt_token_ids=list(prompt),
+                   sampling=SamplingParams(temperature=0.0, max_tokens=n,
+                                           ignore_eos=True), **kw)
+
+
+@pytest.fixture()
+def inject():
+    def make(spec: str = "", seed: int = 0) -> FaultInjector:
+        return install(FaultInjector.from_spec(spec, seed=seed))
+    yield make
+    reset()
+
+
+async def _start_app(app, port):
+    from aiohttp import web
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner
+
+
+# ---------------------------------------------------------------------------
+# wire contract
+# ---------------------------------------------------------------------------
+
+def test_parse_criticality_classes_and_errors():
+    assert parse_criticality({}, {}) == "standard"
+    assert parse_criticality({CRITICALITY_HEADER: "Critical"}, {}) \
+        == "critical"
+    assert parse_criticality({}, {"criticality": "sheddable"}) \
+        == "sheddable"
+    # Header wins over body; unknown class is a client error.
+    assert parse_criticality({CRITICALITY_HEADER: "critical"},
+                             {"criticality": "sheddable"}) == "critical"
+    with pytest.raises(ValueError):
+        parse_criticality({CRITICALITY_HEADER: "urgentest"}, {})
+
+
+def test_parse_deadline_precedence_and_errors():
+    now = 1000.0
+    # Absolute header wins (already stamped by an earlier hop).
+    assert parse_deadline({DEADLINE_ABS_HEADER: "1234.5",
+                           DEADLINE_MS_HEADER: "50"}, {}, now=now) == 1234.5
+    assert parse_deadline({DEADLINE_MS_HEADER: "500"}, {}, now=now) \
+        == pytest.approx(1000.5)
+    # OpenAI-body timeout alias is SECONDS.
+    assert parse_deadline({}, {"timeout": 2}, now=now) \
+        == pytest.approx(1002.0)
+    assert parse_deadline({}, {}) is None
+    for headers, body in (
+            ({DEADLINE_MS_HEADER: "banana"}, {}),
+            ({DEADLINE_ABS_HEADER: "soon"}, {}),
+            ({DEADLINE_MS_HEADER: "-5"}, {}),
+            ({}, {"timeout": "never"})):
+        with pytest.raises(ValueError):
+            parse_deadline(headers, body)
+
+
+# ---------------------------------------------------------------------------
+# engine: deadline metrics + block accounting
+# ---------------------------------------------------------------------------
+
+def test_engine_deadline_rejection_metrics_and_blocks():
+    engine = EngineCore(EngineConfig(**ENGINE_KW))
+    late = greedy_req("late", [1, 2, 3, 4], 8)
+    late.deadline = time.monotonic() - 0.01
+    late.criticality = "sheddable"
+    engine.add_request(late)
+    outs = engine.step()
+    assert [o.finish_reason for o in outs
+            if o.request_id == "late"] == ["deadline"]
+    assert not late.block_ids and not engine.scheduler.has_work()
+    text = engine.metrics.render().decode()
+    assert "llmd_tpu:deadline_exceeded_total" in text
+    assert 'criticality="sheddable"' in text
+    # Queue-wait histogram appears once something real is scheduled.
+    ok = greedy_req("ok", [1, 2, 3, 4], 2)
+    engine.generate([ok])
+    text = engine.metrics.render().decode()
+    assert "llmd_tpu:request_queue_wait_seconds" in text
+    assert 'criticality="standard"' in text
+
+
+# ---------------------------------------------------------------------------
+# P->D: cancellation propagates to the producer's pinned blocks
+# ---------------------------------------------------------------------------
+
+def _drive(engine, until, max_steps=2000):
+    outs = []
+    for _ in range(max_steps):
+        outs.extend(engine.step())
+        if until():
+            return outs
+        if not engine.scheduler.has_work():
+            time.sleep(0.002)
+    raise AssertionError("condition not reached (hung request?)")
+
+
+def _remote_prefill(producer, rid, prompt):
+    preq = greedy_req(rid, prompt, 1, do_remote_decode=True)
+    producer.add_request(preq)
+    _drive(producer,
+           lambda: preq.state == RequestState.FINISHED_REMOTE_PREFILL)
+    return preq.kv_transfer_params
+
+
+@pytest.fixture(scope="module")
+def pd_engines():
+    baseline = EngineCore(EngineConfig(**ENGINE_KW))
+    producer = EngineCore(EngineConfig(**ENGINE_KW), params=baseline.params)
+    producer.kv_connector = TpuConnector(
+        KVConnectorConfig(kv_role="kv_producer", host="127.0.0.1"))
+    yield baseline, producer
+    producer.kv_connector.close()
+
+
+def test_consumer_abort_releases_producer_pins(pd_engines, inject):
+    """Cancel while the KV pull is in flight: the consumer's abort sends
+    an eager release so the producer's pinned prefill blocks free NOW,
+    not at the 120s pin timeout."""
+    baseline, producer = pd_engines
+    inj = inject()
+    inj.add_rule("kv.pull", latency_s=0.3, label="none")   # stall, no fail
+    consumer = EngineCore(EngineConfig(**ENGINE_KW), params=baseline.params)
+    consumer.kv_connector = TpuConnector(KVConnectorConfig(
+        kv_role="kv_consumer", timeout_ms=2000))
+    try:
+        params = _remote_prefill(producer, "cancelme", [5, 4, 3, 2, 1])
+        assert "cancelme" in producer.pinned_transfers
+        dreq = greedy_req("cancelme", [5, 4, 3, 2, 1], 4,
+                          do_remote_prefill=True, kv_transfer_params=params)
+        consumer.add_request(dreq)        # pull stalled at the fault point
+        consumer.abort_request("cancelme")
+        # Producer pins release via the cancel-release, well inside the
+        # pin timeout (drive pumps drain_released).
+        _drive(producer, lambda: not producer.pinned_transfers)
+        _drive(consumer, lambda: dreq.state.finished)
+        assert dreq.state == RequestState.FINISHED_ABORTED
+    finally:
+        consumer.kv_connector.close()
+
+
+def test_consumer_deadline_expiry_drops_pull_before_decode(pd_engines):
+    """A pull that lands after the deadline is dropped at poll() — no
+    local blocks are allocated for a request the client wrote off — and
+    the producer's pins still free."""
+    baseline, producer = pd_engines
+    consumer = EngineCore(EngineConfig(**ENGINE_KW), params=baseline.params)
+    consumer.kv_connector = TpuConnector(KVConnectorConfig(
+        kv_role="kv_consumer", timeout_ms=2000))
+    try:
+        params = _remote_prefill(producer, "tooslow", [9, 9, 8, 8])
+        dreq = greedy_req("tooslow", [9, 9, 8, 8], 4,
+                          do_remote_prefill=True, kv_transfer_params=params)
+        dreq.deadline = time.monotonic() - 0.01
+        consumer.add_request(dreq)
+        outs = _drive(consumer, lambda: dreq.state.finished)
+        assert [o.finish_reason for o in outs
+                if o.request_id == "tooslow"] == ["deadline"]
+        assert not dreq.block_ids
+        _drive(producer, lambda: not producer.pinned_transfers)
+    finally:
+        consumer.kv_connector.close()
+
+
+# ---------------------------------------------------------------------------
+# model server: 504 contract + drain protocol over real HTTP
+# ---------------------------------------------------------------------------
+
+def _start_server_thread(server, port):
+    from aiohttp import web
+    started = threading.Event()
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    url = f"http://127.0.0.1:{port}"
+    for _ in range(100):
+        try:
+            if requests.get(url + "/v1/models", timeout=5).status_code == 200:
+                break
+        except requests.ConnectionError:
+            pass
+        time.sleep(0.1)
+    return url
+
+
+@pytest.fixture(scope="module")
+def lifecycle_server():
+    from llm_d_tpu.server.openai import build_server
+    cfg = EngineConfig(**ENGINE_KW)
+    server = build_server(cfg)
+    url = _start_server_thread(server, free_port())
+    return server, url
+
+
+def test_server_expired_deadline_is_504(lifecycle_server):
+    _server, url = lifecycle_server
+    r = requests.post(url + "/v1/completions",
+                      json={"prompt": "hello", "max_tokens": 2},
+                      headers={DEADLINE_ABS_HEADER: str(time.time() - 5)})
+    assert r.status_code == 504
+    assert r.headers.get(DEADLINE_EXCEEDED_HEADER) == "1"
+    assert "deadline" in r.json()["error"]
+
+
+def test_server_generous_deadline_succeeds(lifecycle_server):
+    _server, url = lifecycle_server
+    r = requests.post(url + "/v1/completions",
+                      json={"prompt": "hello", "max_tokens": 2,
+                            "timeout": 120},
+                      headers={CRITICALITY_HEADER: "critical"})
+    assert r.status_code == 200
+    assert r.json()["choices"][0]["finish_reason"] in ("length", "stop")
+
+
+def test_server_invalid_lifecycle_inputs_400(lifecycle_server):
+    _server, url = lifecycle_server
+    r = requests.post(url + "/v1/completions",
+                      json={"prompt": "x", "max_tokens": 1},
+                      headers={CRITICALITY_HEADER: "mega"})
+    assert r.status_code == 400
+    r = requests.post(url + "/v1/completions",
+                      json={"prompt": "x", "max_tokens": 1},
+                      headers={DEADLINE_MS_HEADER: "banana"})
+    assert r.status_code == 400
+
+
+def test_server_drain_protocol(lifecycle_server):
+    """Runs LAST against this fixture server (drain is one-way): the
+    drain endpoint flips readiness, refuses new inference with 503 +
+    x-llmd-draining, exports drain_state, and liveness stays up."""
+    _server, url = lifecycle_server
+    r = requests.post(url + "/admin/drain")
+    assert r.status_code == 200
+    assert r.json()["status"] == "draining"
+    assert requests.get(url + "/v1/models").status_code == 503
+    assert requests.get(url + "/health").status_code == 200   # liveness
+    r = requests.post(url + "/v1/completions",
+                      json={"prompt": "nope", "max_tokens": 1})
+    assert r.status_code == 503
+    assert r.headers.get(DRAINING_HEADER) == "1"
+    from llm_d_tpu.utils.metrics import parse_prometheus_text
+    m = parse_prometheus_text(requests.get(url + "/metrics").text)
+    assert m.get("llmd_tpu:drain_state") == 1.0
+    # Idempotent.
+    assert requests.post(url + "/admin/drain").status_code == 200
+
+
+# ---------------------------------------------------------------------------
+# sim mirror: the same contract, no accelerator
+# ---------------------------------------------------------------------------
+
+def test_sim_deadline_and_drain_mirror():
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    async def run():
+        port = free_port()
+        srv = build_sim_server(SimConfig(model="sim", ttft_ms=1.0,
+                                         tpot_ms=0.2))
+        runner = await _start_app(srv.build_app(), port)
+        url = f"http://127.0.0.1:{port}"
+        import aiohttp
+        try:
+            async with aiohttp.ClientSession() as sess:
+                # Expired deadline -> 504 + marker, mirroring the server.
+                async with sess.post(f"{url}/v1/completions", json={
+                        "prompt": "late", "max_tokens": 2},
+                        headers={DEADLINE_ABS_HEADER:
+                                 str(time.time() - 5)}) as r:
+                    assert r.status == 504
+                    assert r.headers.get(DEADLINE_EXCEEDED_HEADER) == "1"
+                # Healthy request with budget -> 200.
+                async with sess.post(f"{url}/v1/completions", json={
+                        "prompt": "ok", "max_tokens": 2},
+                        headers={DEADLINE_MS_HEADER: "30000",
+                                 CRITICALITY_HEADER: "critical"}) as r:
+                    assert r.status == 200
+                async with sess.get(f"{url}/metrics") as r:
+                    text = await r.text()
+                assert "llmd_tpu:deadline_exceeded_total" in text
+                assert "llmd_tpu:request_queue_wait_seconds" in text
+                # Drain: readiness flips, new work 503s, metric exports.
+                async with sess.post(f"{url}/admin/drain") as r:
+                    assert r.status == 200
+                async with sess.get(f"{url}/v1/models") as r:
+                    assert r.status == 503
+                async with sess.post(f"{url}/v1/completions", json={
+                        "prompt": "x", "max_tokens": 1}) as r:
+                    assert r.status == 503
+                    assert r.headers.get(DRAINING_HEADER) == "1"
+                async with sess.get(f"{url}/metrics") as r:
+                    from llm_d_tpu.utils.metrics import (
+                        parse_prometheus_text)
+                    m = parse_prometheus_text(await r.text())
+                    assert m.get("llmd_tpu:drain_state") == 1.0
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# gateway: deadline 504 + lifecycle header propagation
+# ---------------------------------------------------------------------------
+
+def test_gateway_expired_deadline_504_and_header_propagation():
+    """The gateway stamps the ABSOLUTE deadline and forwards it with the
+    criticality class; an expired budget 504s at the gateway without
+    burning an upstream forward."""
+    import aiohttp
+
+    from llm_d_tpu.epp.datastore import EndpointState
+    from llm_d_tpu.epp.service import build_gateway
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+
+    async def run():
+        sim_port = free_port()
+        srv = build_sim_server(SimConfig(model="sim", ttft_ms=1.0,
+                                         tpot_ms=0.2))
+        runners = [await _start_app(srv.build_app(), sim_port)]
+        gw = build_gateway(
+            [EndpointState(address=f"127.0.0.1:{sim_port}")],
+            scrape_interval_s=0.05)
+        gw_port = free_port()
+        runners.append(await _start_app(gw.build_app(), gw_port))
+        url = f"http://127.0.0.1:{gw_port}/v1/completions"
+        try:
+            async with aiohttp.ClientSession() as sess:
+                for _ in range(100):
+                    if all(e.ready for e in gw.datastore.candidates()):
+                        break
+                    await asyncio.sleep(0.05)
+                async with sess.post(url, json={
+                        "prompt": "late", "max_tokens": 2},
+                        headers={DEADLINE_MS_HEADER: "0.5"}) as r:
+                    # 0.5ms budget: expired by the time scheduling runs
+                    # (scrape wait above burned it) — or in a freakishly
+                    # fast world the sim honors it; both carry the marker
+                    # path.  Retry once with an already-expired absolute
+                    # header for determinism.
+                    pass
+                async with sess.post(url, json={
+                        "prompt": "late", "max_tokens": 2},
+                        headers={DEADLINE_ABS_HEADER:
+                                 str(time.time() - 1)}) as r:
+                    assert r.status == 504
+                    assert r.headers.get(DEADLINE_EXCEEDED_HEADER) == "1"
+                # A live request rides the absolute deadline + class to
+                # the replica (sim parses both without error) and wins.
+                async with sess.post(url, json={
+                        "prompt": "ok", "max_tokens": 2,
+                        "criticality": "critical"},
+                        headers={DEADLINE_MS_HEADER: "30000"}) as r:
+                    assert r.status == 200
+                async with sess.get(
+                        f"http://127.0.0.1:{gw_port}/metrics") as r:
+                    text = await r.text()
+                assert "llmd_tpu:gateway_deadline_exceeded_total" in text
+        finally:
+            for r in runners:
+                await r.cleanup()
+
+    asyncio.run(run())

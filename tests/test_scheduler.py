@@ -83,3 +83,96 @@ def test_oversized_prompt_rejected():
     out = s.schedule()
     assert r.state == RequestState.FINISHED_LENGTH
     assert r in out.preempted
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: deadline expiry + SLO-class ordering
+# ---------------------------------------------------------------------------
+
+def test_deadline_expired_queued_request_rejected():
+    import time
+    s = mk_sched()
+    dead = mk_req("dead", 4)
+    dead.deadline = time.monotonic() - 0.01       # expired while queued
+    live = mk_req("live", 4)
+    s.add_request(dead)
+    s.add_request(live)
+    out = s.schedule()
+    assert dead.state == RequestState.FINISHED_DEADLINE
+    assert dead in out.preempted
+    assert s.num_deadline_evictions == 1
+    # The live request still schedules this same pass.
+    assert [sr.request.request_id for sr in out.scheduled] == ["live"]
+
+
+def test_deadline_eviction_frees_blocks_same_step():
+    import time
+    # Pool sized so the evicted request's blocks are the ONLY way the
+    # waiting request can be admitted in the same schedule() pass.
+    s = mk_sched(num_blocks=5, block_size=4, max_num_batched_tokens=64)
+    kv = s.kv
+    hog = mk_req("hog", 16)                       # 4 of 4 usable blocks
+    s.add_request(hog)
+    out = s.schedule()
+    assert [sr.request.request_id for sr in out.scheduled] == ["hog"]
+    hog.num_computed_tokens = 16
+    hog.output_token_ids.append(1)                # decoding now
+    assert kv.num_free_blocks == 0
+    hog.deadline = time.monotonic() - 0.01        # budget blown mid-run
+    nxt = mk_req("next", 16)
+    s.add_request(nxt)
+    out = s.schedule()
+    # Eviction and reuse happen in ONE step: hog finished with "deadline",
+    # its blocks freed, and they already serve the next request.
+    assert hog.state == RequestState.FINISHED_DEADLINE
+    assert hog in out.preempted
+    assert not hog.block_ids
+    assert [sr.request.request_id for sr in out.scheduled] == ["next"]
+
+
+def test_sheddable_preempted_before_critical():
+    """Victim selection is class-tiered: when a decode needs blocks, the
+    SHEDDABLE victim is preempted even though a STANDARD request is more
+    recent (pure recency would have picked the standard one)."""
+    def advance(out):
+        for sr in out.scheduled:
+            r = sr.request
+            r.num_computed_tokens += sr.num_new_tokens
+            if r.num_computed_tokens == r.num_tokens:
+                r.output_token_ids.append(1)     # now decoding
+
+    # 12 usable blocks; running order built across passes: [crit, shed,
+    # std] with std the most recent.
+    s = mk_sched(num_blocks=13, block_size=4, max_num_batched_tokens=64)
+    crit = mk_req("crit", 14)
+    crit.criticality = "critical"
+    shed = mk_req("shed", 15)
+    shed.criticality = "sheddable"
+    std = mk_req("std", 15)
+    s.add_request(crit)
+    advance(s.schedule())                        # crit: 4 blocks
+    s.add_request(shed)
+    advance(s.schedule())                        # shed: 4 blocks
+    s.add_request(std)
+    advance(s.schedule())                        # std: 4 blocks; pool full
+    assert s.kv.num_free_blocks == 0
+    # crit's next decode token crosses into a 5th block: preemption.
+    out = s.schedule()
+    assert shed.state == RequestState.PREEMPTED
+    assert shed in s.waiting
+    assert std.state == RequestState.RUNNING     # spared despite recency
+    assert {sr.request.request_id for sr in out.scheduled} \
+        == {"crit", "std"}
+
+
+def test_criticality_tier_orders_queue_admission():
+    s = mk_sched(max_num_batched_tokens=8, max_num_seqs=1)
+    std = mk_req("std", 4)
+    crit = mk_req("crit", 4)
+    crit.criticality = "critical"
+    shed = mk_req("shed", 4)
+    shed.criticality = "sheddable"
+    for r in (shed, std, crit):                   # arrival: worst first
+        s.add_request(r)
+    out = s.schedule()
+    assert out.scheduled[0].request.request_id == "crit"
