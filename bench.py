@@ -133,7 +133,8 @@ def _make_reqs(tag, n, prompt_len, decode_steps, offset):
 
 
 def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
-                quantization=None, repeats=None, stub=()):
+                quantization=None, repeats=None, stub=(),
+                kv_cache_dtype=None):
     """One engine, a workload per batch size (warmup + timed).  Returns
     {bs: {prefill_tok_s, decode_tok_s, ...}} plus roofline attribution.
 
@@ -141,7 +142,10 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
     headline numbers use median-of-N with a printed min/max band so the
     regression gate can tell a real drop from the chip's measured ±4-6%
     run-to-run variance (VERDICT r5 #4).  ``stub`` drops components from
-    the compiled program for the attribution harness (--stub)."""
+    the compiled program for the attribution harness (--stub).
+    ``kv_cache_dtype`` ("bf16"/"int8") sets the paged-cache dtype — the
+    roofline's KV byte term and the reported ``kv_bytes_per_step`` follow
+    it (int8 halves the stream; scale planes are counted)."""
     max_bs = max(batch_sizes)
     # KV sized to the workload + slack: the tunnel chip's usable HBM is
     # well under the nominal 16 GB, so a fixed large pool OOMs the MoE run.
@@ -161,6 +165,7 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
         # removes any chance the warmup pass warms more than the compiles.
         enable_prefix_caching=False,
         quantization=quantization,
+        kv_cache_dtype=kv_cache_dtype,
         stub_components=tuple(stub),
     )
     engine = EngineCore(cfg)
@@ -175,8 +180,7 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
     # sequence's KV context.  MoE note: at bs*k >= E every expert is
     # touched every step, so the full expert set streams regardless of
     # batch size — the wide-EP decode economics this bench exists to show.
-    layout = engine.model.kv_cache_layout(c)
-    kv_row = sum(layout.values()) * 2      # bytes/token/layer
+    kv_row = engine.kv_bytes_per_token_layer()   # bytes/token/layer
 
     out = {}
     for bs in batch_sizes:
@@ -206,10 +210,13 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
             / t_prefill / peak_flops
         decode_mfu = decode_tok_s * (body_flops + head_flops) / peak_flops
         avg_ctx = prompt_len + decode_steps // 2
-        step_bytes = (param_bytes - embed_bytes
-                      + bs * c.num_layers * avg_ctx * kv_row)
+        kv_bytes_per_step = bs * c.num_layers * avg_ctx * kv_row
+        step_bytes = param_bytes - embed_bytes + kv_bytes_per_step
         roofline_tok_s = hbm_bw / step_bytes * bs
         out[bs] = {
+            # The KV byte stream one decode step reads at avg context —
+            # the component kv_cache_dtype=int8 exists to halve.
+            "kv_bytes_per_step": kv_bytes_per_step,
             "prefill_tok_s": round(prefill_tok_s, 1),
             "decode_tok_s": round(decode_tok_s, 1),
             "prefill_mfu_pct": round(100 * prefill_mfu, 2),
@@ -239,6 +246,9 @@ def bench_model(model: str, batch_sizes, prompt_len=128, decode_steps=128,
                 100 * (max(prefill_runs) - min(prefill_runs))
                 / max(prefill_tok_s, 1e-9), 1)
     out["param_bytes"] = param_bytes
+    out["kv_cache_dtype"] = engine.kv_cache_dtype
+    out["kv_bytes_per_token_layer"] = kv_row
+    out["num_blocks"] = engine.config.num_blocks
     return out
 
 
@@ -361,28 +371,43 @@ def v5p256_sensitivity(measured_roofline_frac: float) -> dict:
             "bar_tok_s_chip": bar}
 
 
-def _regression_gate(dense: dict, moe: dict) -> dict:
-    """Band-aware regression gate over the THREE headline metrics (two
-    decode, one prefill — prefill regressions used to land silently).
+def _regression_gate(dense: dict, moe: dict, longctx: dict = None) -> dict:
+    """Band-aware regression gate over the FOUR headline metrics (two
+    decode, one prefill, one long-context int8-KV decode — prefill and
+    KV-byte regressions used to land silently).
 
     ``*_delta_pct`` is the MEDIAN's delta vs the best recorded number;
     ``*_regressed`` is True only when the run band's MAX is below it —
     i.e. not even the luckiest of N runs reached the old number, which a
     ±4-6% noise band cannot explain.  Gate on ``*_regressed``, read
-    ``*_delta_pct`` for trend."""
+    ``*_delta_pct`` for trend.  A metric whose best is None is being
+    RECORDED for the first time (no verdict until a chip run pins it)."""
     gate = {}
     for name, sweep, bs, phase, best in (
             ("dense_bs64", dense, 64, "decode", 11196.7),   # BENCH_r03
             ("moe_bs256", moe, 256, "decode", 16060.6),     # r5 final
             # BENCH_r05 moe bs64 prefill (the 11.46%-MFU number the
             # streamed kernel exists to beat).
-            ("moe_prefill_tok_s_bs64", moe, 64, "prefill", 17105.1)):
+            ("moe_prefill_tok_s_bs64", moe, 64, "prefill", 17105.1),
+            # Long-context (ctx 2048) dense decode with the int8 KV cache:
+            # the regime where the KV stream dominates step bytes, so a
+            # quantization-path regression shows here first.  First chip
+            # run after the int8-KV PR records the best.
+            ("dense_longctx_int8_bs64", longctx or {}, 64, "decode", None)):
         gate[f"{name}_best_recorded"] = best
         if bs not in sweep:
             gate[f"{name}_delta_pct"] = None
             continue
         row = sweep[bs]
         med = row[f"{phase}_tok_s"]
+        if best is None:
+            gate[f"{name}_recorded"] = med
+            gate[f"{name}_delta_pct"] = None
+            gate[f"{name}_regressed"] = None
+            band = row.get(f"{phase}_tok_s_band")
+            if band is not None:
+                gate[f"{name}_band"] = band
+            continue
         gate[f"{name}_delta_pct"] = round(100 * (med / best - 1), 1)
         if phase == "prefill" and f"{phase}_mfu_pct" in row:
             # The ≥20% prefill-MFU target rides along with the verdict.
@@ -397,6 +422,23 @@ def _regression_gate(dense: dict, moe: dict) -> dict:
             gate[f"{name}_band"] = band
             gate[f"{name}_regressed"] = bool(band[1] < best)
     return gate
+
+
+def _kv_block_pool_table(budget_bytes: int = 4 << 30) -> dict:
+    """Capacity half of the int8-KV win: blocks a fixed HBM budget holds
+    per cache dtype (dense llama3-1b layout, block_size 64) — the larger
+    pool IS the larger max batch / longer max context at the same chip."""
+    from llm_d_tpu.engine.engine import derive_num_blocks
+    from llm_d_tpu.models import get_model
+    from llm_d_tpu.models.config import get_config
+    c = get_config("llama3-1b")
+    layout = get_model(c).kv_cache_layout(c)
+    bf16 = derive_num_blocks(budget_bytes, layout, c.num_layers, 64, "bf16")
+    int8 = derive_num_blocks(budget_bytes, layout, c.num_layers, 64,
+                             "int8", 1)
+    return {"budget_gb": round(budget_bytes / 2**30, 1),
+            "bf16_blocks": bf16, "int8_blocks": int8,
+            "ratio": round(int8 / bf16, 3)}
 
 
 # Components the attribution sweep stubs one at a time ("none" is the
@@ -524,6 +566,22 @@ def main() -> None:
     moe = bench_model("deepseek-v3-bench", moe_sizes, quantization="int8",
                       repeats={256: n, 64: n})
     dense = bench_model("llama3-1b", dense_sizes, repeats={64: n})
+    # Long-context decode (ctx 2048, bs64) on the int8 KV cache — the
+    # regime where the KV stream dominates step bytes, so this is the
+    # gated canary for the kv_cache_dtype path — plus one bf16 point at
+    # the same shape so "no worse than bf16" and the ~2x kv_bytes_per_step
+    # reduction are visible side by side in extras.
+    # --quick skips the long-context pair entirely: the metric is
+    # band-gated (a single sample can't gate) and the ctx-2048 engine
+    # build + sweep would dominate the dev loop.
+    longctx_prompt, longctx_decode = 2048 - 128, 128
+    longctx_i8 = (None if args.quick else bench_model(
+        "llama3-1b", [64], prompt_len=longctx_prompt,
+        decode_steps=longctx_decode, kv_cache_dtype="int8",
+        repeats={64: n}))
+    longctx_bf = (None if args.quick else bench_model(
+        "llama3-1b", [64], prompt_len=longctx_prompt,
+        decode_steps=longctx_decode, kv_cache_dtype="bf16"))
 
     best_bs = max(moe_sizes, key=lambda b: moe[b]["decode_tok_s"])
     headline = moe[best_bs]["decode_tok_s"]
@@ -540,6 +598,21 @@ def main() -> None:
         "dense_model": "llama3-1b",
         "dense_param_gb": round(dense["param_bytes"] / 1e9, 2),
         "dense_sweep": {str(b): dense[b] for b in dense_sizes},
+        # int8 paged-KV cache: long-context decode side-by-side (the
+        # kv_bytes_per_step ratio is the HBM win; the block-pool table is
+        # the capacity win at a fixed 4 GiB budget).
+        "longctx_sweep": {
+            "context_len": longctx_prompt + longctx_decode,
+            "int8": (None if longctx_i8 is None else
+                     {"64": longctx_i8[64],
+                      "kv_bytes_per_token_layer":
+                          longctx_i8["kv_bytes_per_token_layer"]}),
+            "bf16": (None if longctx_bf is None else
+                     {"64": longctx_bf[64],
+                      "kv_bytes_per_token_layer":
+                          longctx_bf["kv_bytes_per_token_layer"]}),
+        },
+        "kv_block_pool": _kv_block_pool_table(),
         "decode_output_tok_s_per_chip_llama1b_bs64":
             dense[64]["decode_tok_s"] if 64 in dense else None,
         # North-star paper model: real DeepSeek-V3 wide-EP on v5p-256,
@@ -561,7 +634,7 @@ def main() -> None:
         # band.  A metric REGRESSES only when its whole band sits below
         # the best recorded number — a point sample inside the chip's
         # measured ±4-6% variance is noise, not a regression.
-        "regression_gate": _regression_gate(dense, moe),
+        "regression_gate": _regression_gate(dense, moe, longctx_i8),
     }
     result = {
         "metric": "decode_output_tok_s_per_chip_moe",

@@ -33,6 +33,9 @@ from llm_d_tpu.models.config import ModelConfig, get_config
 from llm_d_tpu.ops import sampling as sampling_ops
 from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
 from llm_d_tpu.parallel.sharding import logical_to_sharding, shard_pytree
+from llm_d_tpu.ops.quant import (
+    KV_CACHE_DTYPES, KV_SCALE_GRANULARITIES, kv_scale_width)
+from llm_d_tpu.utils.config import env_choice
 from llm_d_tpu.utils.faultinject import get_injector
 from llm_d_tpu.utils.metrics import EngineMetrics
 
@@ -44,6 +47,40 @@ def _next_bucket(n: int, lo: int, hi: int) -> int:
     while b < n:
         b *= 2
     return min(b, hi)
+
+
+def kv_bytes_per_token(layout: Dict[str, int], kv_cache_dtype: str = "bf16",
+                       scale_width: int = 1) -> int:
+    """Bytes one token's KV costs per layer at a cache dtype: payload rows
+    plus, for int8, a per-page-row f32 scale column group (``scale_width``
+    columns per buffer).  The single source of the byte accounting shared
+    by pool sizing and the bench's roofline/kv_bytes_per_step terms."""
+    per = sum(layout.values()) * (1 if kv_cache_dtype == "int8" else 2)
+    if kv_cache_dtype == "int8":
+        per += len(layout) * scale_width * 4
+    return per
+
+
+def kv_block_bytes(layout: Dict[str, int], num_layers: int, block_size: int,
+                   kv_cache_dtype: str = "bf16", scale_width: int = 1) -> int:
+    """HBM bytes one KV block costs across all layers and cache buffers —
+    the int8 scale overhead is what keeps the capacity gain at ~1.95x
+    rather than exactly 2x."""
+    return num_layers * block_size * kv_bytes_per_token(
+        layout, kv_cache_dtype, scale_width)
+
+
+def derive_num_blocks(hbm_budget_bytes: int, layout: Dict[str, int],
+                      num_layers: int, block_size: int,
+                      kv_cache_dtype: str = "bf16",
+                      scale_width: int = 1) -> int:
+    """Dtype-aware block-pool sizing: how many paged-KV blocks fit a fixed
+    HBM budget.  The int8 cache roughly DOUBLES the pool at the same budget
+    (same chip serves ~2x the batch or context), which is the capacity half
+    of the kv_cache_dtype=int8 win alongside the halved decode DMA bytes."""
+    per_block = kv_block_bytes(layout, num_layers, block_size,
+                               kv_cache_dtype, scale_width)
+    return max(hbm_budget_bytes // per_block, 2)
 
 
 @dataclasses.dataclass
@@ -96,6 +133,18 @@ class EngineConfig:
     kv_shared_tier_peers: Tuple[str, ...] = ()  # "host:port" peer servers
     # MoE expert-weight quantization (DeepGEMM role; "int8" or None).
     quantization: Optional[str] = None
+    # Paged-KV cache dtype: "bf16" (classic) or "int8" (per-page-row-scaled
+    # payloads + f32 scale planes — halves decode HBM/DMA bytes, ~doubles
+    # the block pool at the same budget, halves P->D and offload payloads).
+    # None resolves LLMD_KV_CACHE_DTYPE (default bf16) at engine build.
+    kv_cache_dtype: Optional[str] = None
+    # int8 scale granularity: "token" (one f32 scale per cache row) or
+    # "head" (one per KV head's D-block — finer, shard-local under
+    # tp-sharded KV heads).  None resolves LLMD_KV_SCALE_GRAN.
+    kv_scale_granularity: Optional[str] = None
+    # Auto-size the block pool from an HBM budget instead of num_blocks:
+    # dtype-aware (int8 fits ~2x the blocks), see derive_num_blocks.
+    kv_cache_hbm_bytes: Optional[int] = None
     # Perf-attribution harness (docs/perf-notes methodology): components
     # to STUB OUT of the step program so their cost can be measured by
     # difference, in a fresh process, on BOTH phases (prefill + decode —
@@ -121,6 +170,50 @@ class EngineCore:
         self.config = config
         self.model_config = config.resolve_model()
         c = self.model_config
+        # KV cache dtype: explicit config wins; None resolves the env knob
+        # (invalid ENV values fall back with a warning, an invalid EXPLICIT
+        # value is a misconfiguration and raises).
+        self.kv_cache_dtype = config.kv_cache_dtype or env_choice(
+            "LLMD_KV_CACHE_DTYPE", "bf16", KV_CACHE_DTYPES)
+        if self.kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r} "
+                f"(choices: {KV_CACHE_DTYPES})")
+        self.kv_quantized = self.kv_cache_dtype == "int8"
+        gran = config.kv_scale_granularity or env_choice(
+            "LLMD_KV_SCALE_GRAN", "token", KV_SCALE_GRANULARITIES)
+        if gran not in KV_SCALE_GRANULARITIES:
+            raise ValueError(
+                f"unknown kv_scale_granularity {gran!r} "
+                f"(choices: {KV_SCALE_GRANULARITIES})")
+        self.kv_scale_granularity = gran
+        if self.kv_quantized and c.use_mla:
+            # The MLA latent row IS the cache-compression play (576 values
+            # vs 32768 materialized for V3) and its kernels attend over the
+            # latent directly; int8 targets the dense K/V byte stream.
+            # Serving MLA silently in bf16 while the operator believes the
+            # cache was halved would be a misconfiguration, not a fallback.
+            raise ValueError(
+                "kv_cache_dtype='int8' quantizes the dense K/V cache; "
+                f"model {c.name!r} uses MLA (latent cache stays bf16)")
+        self.kv_scale_width = (kv_scale_width(c.num_kv_heads, gran)
+                               if self.kv_quantized else 0)
+        if config.kv_cache_hbm_bytes:
+            # Dtype-aware pool sizing: same budget, ~2x the int8 blocks.
+            # The budget is PER DEVICE: stacked (SPMD dp) engines split the
+            # pool 1/dp per shard, so the global count scales by dp to keep
+            # each chip's residency at the budget.
+            dp = config.mesh.dp if config.mesh else 1
+            derived = dp * derive_num_blocks(
+                config.kv_cache_hbm_bytes,
+                get_model(c).kv_cache_layout(c), c.num_layers,
+                config.block_size, self.kv_cache_dtype, self.kv_scale_width)
+            logger.info(
+                "kv pool auto-sized: %d blocks (%s, %.2f GiB/device budget"
+                ", dp=%d)", derived, self.kv_cache_dtype,
+                config.kv_cache_hbm_bytes / 2**30, dp)
+            config = dataclasses.replace(config, num_blocks=derived)
+            self.config = config
         if config.async_scheduling and config.num_scheduler_steps <= 1:
             # The pipeline operates on fused decode blocks; without them the
             # flag would be a silent no-op.
@@ -196,29 +289,40 @@ class EngineCore:
         # and contiguous scatter rows (see ops/attention.py docstring).
         # Buffer names/widths come from the model: dense models carry
         # {k, v} of KVH*D each; MLA models ONE latent buffer (models/mla).
+        # kv_cache_dtype=int8 stores int8 payloads and adds a sibling
+        # "<name>_scale" f32 plane per buffer (per-page-row scales) — the
+        # scale planes are ordinary cache buffers, so the offload tier and
+        # the P->D wire stage/ship them through the same generic machinery.
         # Stacked mode prepends a [dp] dim sharded over the dp axis: each
         # shard owns slots_local = num_slots/dp rows — per-device KV
         # capacity scales 1/dp, the wide-EP memory profile.
         layout = self.model.kv_cache_layout(c)
+        specs = self.model.kv_cache_spec(c)
+        payload_dtype = jnp.int8 if self.kv_quantized else jnp.bfloat16
+        buffers = {}   # name -> (width, dtype, PartitionSpec)
+        for name, width in layout.items():
+            buffers[name] = (width, payload_dtype, specs[name])
+            if self.kv_quantized:
+                # "head" granularity shards scales like the payload's folded
+                # head dim; "token" has one column, necessarily replicated.
+                s_spec = (P(None, None, "tp")
+                          if self.kv_scale_width > 1 else P())
+                buffers[f"{name}_scale"] = (
+                    self.kv_scale_width, jnp.float32, s_spec)
         if self.dp > 1:
             slots_local = num_slots // self.dp
-            kv_sharding = {
-                name: NamedSharding(self.mesh, P("dp", *spec))
-                for name, spec in self.model.kv_cache_spec(c).items()}
             self.kv_cache = {
                 name: jax.device_put(
                     jnp.zeros((self.dp, c.num_layers, slots_local, width),
-                              jnp.bfloat16), kv_sharding[name])
-                for name, width in layout.items()}
+                              dtype),
+                    NamedSharding(self.mesh, P("dp", *spec)))
+                for name, (width, dtype, spec) in buffers.items()}
         else:
-            kv_sharding = {
-                name: NamedSharding(self.mesh, spec)
-                for name, spec in self.model.kv_cache_spec(c).items()}
             self.kv_cache = {
                 name: jax.device_put(
-                    jnp.zeros((c.num_layers, num_slots, width), jnp.bfloat16),
-                    kv_sharding[name])
-                for name, width in layout.items()}
+                    jnp.zeros((c.num_layers, num_slots, width), dtype),
+                    NamedSharding(self.mesh, spec))
+                for name, (width, dtype, spec) in buffers.items()}
         self._replicated = NamedSharding(self.mesh, P())
         self._dp_sharded = NamedSharding(self.mesh, P("dp"))
 
@@ -715,6 +819,14 @@ class EngineCore:
         req = self.pinned_transfers.pop(request_id, None)
         if req is not None:
             self.kv_manager.free(req)
+
+    def kv_bytes_per_token_layer(self) -> int:
+        """Bytes one token's KV costs per layer at the configured cache
+        dtype — the byte term bench's HBM-roofline accounting streams per
+        decode step (same accounting the pool sizing charges)."""
+        return kv_bytes_per_token(
+            self.model.kv_cache_layout(self.model_config),
+            self.kv_cache_dtype, self.kv_scale_width)
 
     # ---------- batch building ----------
 

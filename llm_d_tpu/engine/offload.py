@@ -45,40 +45,71 @@ from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 
 logger = logging.getLogger(__name__)
 
-_SLAB_HEADER = struct.Struct("<III")    # num_buffers, L, bs
-_SLAB_BUF = struct.Struct("<I")         # row width
+# Slab version 2 (kv_cache_dtype era): per-buffer dtype codes — int8
+# caches stage int8 rows + f32 scale planes (half the host RAM and wire
+# bytes per block), and a pod whose cache dtype differs REJECTS the blob
+# instead of reinterpreting it (shared-tier peers may be rolled at
+# different configs).  Codes live in transfer/transport.py — the same
+# registry the P->D wire uses.
+_SLAB_VERSION = 2
+_SLAB_HEADER = struct.Struct("<IIII")   # version, num_buffers, L, bs
+_SLAB_BUF = struct.Struct("<IB")        # (row width, dtype code)
 
 
 def _shared_key(block_hash: bytes) -> str:
     return "b:" + block_hash.hex()
 
 
+def _slab_layout(engine) -> List[tuple]:
+    """Expected slab segments, sorted by name: (name, width, np dtype)."""
+    stacked = getattr(engine, "dp", 1) > 1
+    return [(name, buf.shape[3] if stacked else buf.shape[2],
+             np.dtype(buf.dtype))
+            for name, buf in _cache_items(engine)]
+
+
 def _pack_block_slab(slab: Dict[str, np.ndarray]) -> bytes:
     names = sorted(slab)
     L, bs, _ = slab[names[0]].shape
-    parts = [_SLAB_HEADER.pack(len(names), L, bs)]
+    parts = [_SLAB_HEADER.pack(_SLAB_VERSION, len(names), L, bs)]
     for n in names:
-        parts.append(_SLAB_BUF.pack(slab[n].shape[2]))
+        parts.append(_SLAB_BUF.pack(
+            slab[n].shape[2], transport.wire_dtype_code(slab[n].dtype)))
         parts.append(np.ascontiguousarray(slab[n]).tobytes())
     return b"".join(parts)
 
 
-def _unpack_block_slab(blob: bytes, names: List[str],
+def _unpack_block_slab(blob: bytes, layout: List[tuple],
                        L: int, bs: int) -> Dict[str, np.ndarray]:
-    import ml_dtypes
-    nb, bL, bbs = _SLAB_HEADER.unpack_from(blob, 0)
-    if (nb, bL, bbs) != (len(names), L, bs):
+    ver, nb, bL, bbs = _SLAB_HEADER.unpack_from(blob, 0)
+    if ver != _SLAB_VERSION:
+        raise ValueError(f"KV slab version {ver} != {_SLAB_VERSION} "
+                         "(peer running an incompatible build)")
+    if (nb, bL, bbs) != (len(layout), L, bs):
         raise ValueError(f"slab layout {(nb, bL, bbs)} != "
-                         f"{(len(names), L, bs)}")
+                         f"{(len(layout), L, bs)}")
     off = _SLAB_HEADER.size
     out = {}
-    for n in sorted(names):
-        (w,) = _SLAB_BUF.unpack_from(blob, off)
+    for name, width, dtype in layout:
+        w, code = _SLAB_BUF.unpack_from(blob, off)
         off += _SLAB_BUF.size
+        if w != width:
+            raise ValueError(
+                f"buffer {name!r}: slab width {w} != cache {width}")
+        try:
+            blob_dtype = transport.wire_dtype(code)
+        except transport.TransferError as e:
+            raise ValueError(str(e)) from e
+        if blob_dtype != dtype:
+            # A bf16 pod must not reinterpret an int8 peer's blocks (and
+            # vice versa): kv_cache_dtype is part of the tier contract.
+            raise ValueError(
+                f"buffer {name!r}: slab holds {blob_dtype} but this pod's "
+                f"cache is {dtype} — kv_cache_dtype mismatch, rejecting")
         count = L * bs * w
-        out[n] = np.frombuffer(blob, dtype=ml_dtypes.bfloat16,
-                               offset=off, count=count).reshape(L, bs, w)
-        off += count * 2
+        out[name] = np.frombuffer(blob, dtype=blob_dtype, offset=off,
+                                  count=count).reshape(L, bs, w)
+        off += count * blob_dtype.itemsize
     return out
 
 
@@ -315,7 +346,7 @@ class HostKVTier:
         stacked = getattr(e, "dp", 1) > 1
         items = _cache_items(e)
         L = items[0][1].shape[1] if stacked else items[0][1].shape[0]
-        slab = _unpack_block_slab(blob, [n for n, _ in items], L, bs)
+        slab = _unpack_block_slab(blob, _slab_layout(e), L, bs)
         local = km.local_block_id(b) if stacked else b
         ids_dev = jax.numpy.asarray(np.asarray([local], np.int32))
         for name, arr in slab.items():
@@ -346,7 +377,7 @@ class HostKVTier:
         e = self.engine
         key = _shared_key(block_hash)
         items = _cache_items(e)
-        names = [n for n, _ in items]
+        layout = _slab_layout(e)
         stacked = getattr(e, "dp", 1) > 1
         L = items[0][1].shape[1] if stacked else items[0][1].shape[0]
         bs = e.config.block_size
@@ -360,7 +391,9 @@ class HostKVTier:
                 get_injector().check("kv.peer_fetch", key=peer)
                 blob = transport.fetch(host, int(port), key,
                                        timeout_ms=self.peer_timeout_ms)
-                _unpack_block_slab(blob, names, L, bs)   # validate layout
+                # Validate layout AND dtype: a dtype-mismatched peer's blob
+                # is a ValueError here, counted as a peer failure below.
+                _unpack_block_slab(blob, layout, L, bs)
             except transport.TransferNotFound:
                 # Peer alive, block absent: a healthy miss.
                 self._peer_health.pop(peer, None)

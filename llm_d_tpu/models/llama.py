@@ -72,11 +72,13 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
 
 def attention_block(
     lp: Params, config: ModelConfig, x: jax.Array, batch: Dict[str, jax.Array],
-    k_cache: jax.Array, v_cache: jax.Array, block_size: int, attn_backend: str,
+    caches: Tuple[jax.Array, ...], block_size: int, attn_backend: str,
     layer: jax.Array = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Shared by dense and MoE models. Returns (attn_out, k_cache', v_cache').
+) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+    """Shared by dense and MoE models. Returns (attn_out, caches').
 
+    ``caches`` is (k, v) for the bf16 cache or (k, v, k_scale, v_scale)
+    when ``kv_cache_dtype=int8`` (int8 payloads + f32 per-row scale planes).
     With ``layer`` the caches are the full stacked [L, slots, F] buffers
     updated in place (see ops.attention.attention_with_kv_update)."""
     c = config
@@ -94,11 +96,13 @@ def attention_block(
     q = L.apply_rope(q, cos, sin)
     kx = L.apply_rope(kx, cos, sin)
 
-    attn, k_cache, v_cache = attention_with_kv_update(
-        q, kx, vx, k_cache, v_cache, batch,
-        block_size=block_size, backend=attn_backend, layer=layer)
+    k_scale, v_scale = caches[2:] if len(caches) == 4 else (None, None)
+    attn, *new_caches = attention_with_kv_update(
+        q, kx, vx, caches[0], caches[1], batch,
+        block_size=block_size, backend=attn_backend, layer=layer,
+        k_scale=k_scale, v_scale=v_scale)
     out = L.linear(attn.reshape(T, c.num_heads * dh), lp["o_proj"])
-    return out, k_cache, v_cache
+    return out, tuple(new_caches)
 
 
 def forward(
@@ -125,35 +129,38 @@ def forward(
     stacked = batch["token_ids"].ndim == 2
     x = params["embed"][batch["token_ids"]]          # [T, D] / [dp, T_l, D]
 
+    # int8 KV: the f32 scale planes ride the scan carry right next to their
+    # payload buffers (name order fixed so the returned dict matches the
+    # engine's buffer set exactly).
+    cache_names = ("k", "v", "k_scale", "v_scale") \
+        if "k_scale" in kv_cache else ("k", "v")
+    caches0 = tuple(kv_cache[n] for n in cache_names)
+
     # The FULL stacked KV cache rides the scan carry and each layer updates
     # its plane in place (Pallas aliasing / scatter-at-layer): slicing the
     # cache into per-layer xs/ys moved 2x the whole cache through HBM every
     # step (~10 ms at 1B scale) — the dominant decode cost before this.
     def attend(lp, hn, caches, ab, li):
-        a, kv_k, kv_v = attention_block(
-            lp, c, hn, ab, caches[0], caches[1], block_size,
-            attn_backend, layer=li)
-        return a, (kv_k, kv_v)
+        return attention_block(
+            lp, c, hn, ab, caches, block_size, attn_backend, layer=li)
 
     def layer_body(carry, lp):
-        h, kv_k, kv_v, li = carry
+        h, caches, li = carry
         hn = L.rms_norm(h, lp["input_norm"], c.rms_norm_eps)
         if stacked:
             from llm_d_tpu.parallel.dp_attention import dp_attend
-            a, (kv_k, kv_v) = dp_attend(
-                attend, mesh, lp, hn, (kv_k, kv_v), batch, li)
+            a, caches = dp_attend(attend, mesh, lp, hn, caches, batch, li)
         else:
-            a, (kv_k, kv_v) = attend(lp, hn, (kv_k, kv_v), batch, li)
+            a, caches = attend(lp, hn, caches, batch, li)
         h = h + a
         m = L.swiglu_mlp(
             L.rms_norm(h, lp["post_attn_norm"], c.rms_norm_eps),
             lp["gate_proj"], lp["up_proj"], lp["down_proj"])
         h = h + m
-        return (h, kv_k, kv_v, li + 1), None
+        return (h, caches, li + 1), None
 
-    (x, k_new, v_new, _), _ = jax.lax.scan(
-        layer_body, (x, kv_cache["k"], kv_cache["v"], jnp.int32(0)),
-        params["layers"])
+    (x, caches, _), _ = jax.lax.scan(
+        layer_body, (x, caches0, jnp.int32(0)), params["layers"])
 
     x = L.rms_norm(x, params["final_norm"], c.rms_norm_eps)
     # Only sampling positions need logits: gather last-token rows per sequence.
@@ -162,7 +169,7 @@ def forward(
             x, batch["sample_idx"][..., None], axis=1)   # [dp, S_l, D]
     else:
         sample_hidden = x[batch["sample_idx"]]           # [S, D]
-    return sample_hidden, {"k": k_new, "v": v_new}
+    return sample_hidden, dict(zip(cache_names, caches))
 
 
 def compute_logits(params: Params, hidden: jax.Array, config: ModelConfig) -> jax.Array:

@@ -124,7 +124,14 @@ def forward(
     Ld = c.first_dense_layers
     stacked = batch["token_ids"].ndim == 2
     x = params["embed"][batch["token_ids"]]   # [T, D] / [dp, T_l, D]
-    cache_keys = ("kv",) if c.use_mla else ("k", "v")
+    # int8 KV (dense K/V models only — the MLA latent cache stays bf16,
+    # engine-enforced): scale planes ride the scan carry with the payloads.
+    if c.use_mla:
+        cache_keys = ("kv",)
+    elif "k_scale" in kv_cache:
+        cache_keys = ("k", "v", "k_scale", "v_scale")
+    else:
+        cache_keys = ("k", "v")
     # DBO threshold by phase: the program's query width is static under jit,
     # and Q == 1 holds exactly for pure-decode programs (single-step or
     # fused).  None (no opts) lets the op consult its standalone env vars;
@@ -145,10 +152,8 @@ def forward(
                 lp, c, hn, ab, caches[0], block_size, attn_backend,
                 layer=li)
             return a, (kv,)
-        a, kv_k, kv_v = attention_block(
-            lp, c, hn, ab, caches[0], caches[1], block_size,
-            attn_backend, layer=li)
-        return a, (kv_k, kv_v)
+        return attention_block(
+            lp, c, hn, ab, caches, block_size, attn_backend, layer=li)
 
     def attend(lp, hn, caches, li):
         """Stacked mode: per-dp-shard attention (manual dp, auto tp) —

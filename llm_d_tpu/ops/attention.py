@@ -33,7 +33,25 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from llm_d_tpu.ops.quant import dequantize_kv_block, quantize_kv_block
+
 NEG_INF = -1e30
+
+
+def _gather_rows(cache: jax.Array, scale: "Optional[jax.Array]",
+                 idx: jax.Array, layer: Optional[jax.Array]):
+    """Row gather with optional int8 dequantization.
+
+    ``cache`` is ``[num_slots, W]`` (or stacked ``[L, slots, W]`` with
+    ``layer``); int8 caches carry a sibling f32 ``scale`` plane
+    ``[..., slots, SW]`` and gathered rows come back dequantized to f32 —
+    the XLA fallback's dequantize-then-attend path, numerically identical
+    to the in-VMEM dequant the Pallas kernels do after the page DMA."""
+    rows = cache[idx] if layer is None else cache[layer, idx]
+    if scale is None:
+        return rows.astype(jnp.float32)
+    s = scale[idx] if layer is None else scale[layer, idx]
+    return dequantize_kv_block(rows, s, jnp.float32)
 
 
 def ragged_paged_attention_reference(
@@ -48,6 +66,8 @@ def ragged_paged_attention_reference(
     scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
     layer: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,   # int8 caches: f32 scale planes
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:               # [T, H, D]
     T, H, D = q.shape
     S, B = block_tables.shape
@@ -59,12 +79,10 @@ def ragged_paged_attention_reference(
     slot_ids = (block_tables[:, :, None] * block_size
                 + jnp.arange(block_size)[None, None, :]).reshape(S, B * block_size)
     C = B * block_size
-    if layer is None:
-        k_seq = k_cache[slot_ids].reshape(S, C, KVH, D)
-        v_seq = v_cache[slot_ids].reshape(S, C, KVH, D)
-    else:
-        k_seq = k_cache[layer, slot_ids].reshape(S, C, KVH, D)
-        v_seq = v_cache[layer, slot_ids].reshape(S, C, KVH, D)
+    k_seq = _gather_rows(k_cache, k_scale, slot_ids, layer).reshape(
+        S, C, KVH, D)
+    v_seq = _gather_rows(v_cache, v_scale, slot_ids, layer).reshape(
+        S, C, KVH, D)
 
     # Per-token context: [T, C, KVH, D].
     k_tok = k_seq[token_seq_ids]
@@ -118,6 +136,21 @@ def write_kv(
     return k_cache, v_cache
 
 
+def write_scales(
+    scale_cache: jax.Array,   # [num_slots, SW] or stacked [L, slots, SW]
+    scales_new: jax.Array,    # [T, SW] f32 per-row scales
+    slot_mapping: jax.Array,
+    layer: Optional[jax.Array] = None,
+):
+    """Scatter this step's per-row KV scales next to their int8 rows (the
+    scale plane mirrors the payload cache's slot addressing exactly)."""
+    if layer is None:
+        return scale_cache.at[slot_mapping].set(
+            scales_new.astype(scale_cache.dtype))
+    return scale_cache.at[layer, slot_mapping].set(
+        scales_new.astype(scale_cache.dtype))
+
+
 def _flash_over_kv_chunks(
     qs: jax.Array,        # [S, Q, H, D] padded per-seq queries
     q_pos: jax.Array,     # [S, Q] absolute positions (pad -> -1)
@@ -126,6 +159,8 @@ def _flash_over_kv_chunks(
     k_cache: jax.Array, v_cache: jax.Array,
     kv_chunk: int, scale: float, soft_cap: Optional[float],
     layer: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:           # [S, Q, H, D]
     """Online-softmax attention scanning the context in kv_chunk slices.
 
@@ -145,12 +180,10 @@ def _flash_over_kv_chunks(
     def compute_chunk(carry, ci):
         m, l, acc = carry
         sl = jax.lax.dynamic_slice_in_dim(slot_ids, ci * kv_chunk, kv_chunk, 1)
-        if layer is None:
-            k = k_cache[sl].reshape(S, kv_chunk, KVH, D).astype(jnp.float32)
-            v = v_cache[sl].reshape(S, kv_chunk, KVH, D).astype(jnp.float32)
-        else:
-            k = k_cache[layer, sl].reshape(S, kv_chunk, KVH, D).astype(jnp.float32)
-            v = v_cache[layer, sl].reshape(S, kv_chunk, KVH, D).astype(jnp.float32)
+        k = _gather_rows(k_cache, k_scale, sl, layer).reshape(
+            S, kv_chunk, KVH, D)
+        v = _gather_rows(v_cache, v_scale, sl, layer).reshape(
+            S, kv_chunk, KVH, D)
         s = jnp.einsum("sqkgd,sckd->sqkgc", qf, k)   # [S, Q, KVH, G, kc]
         if soft_cap is not None:
             s = soft_cap * jnp.tanh(s / soft_cap)
@@ -213,6 +246,8 @@ def _flash_batched_q_chunks(
     k_cache: jax.Array, v_cache: jax.Array,
     scale: float, soft_cap: Optional[float],
     layer: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:           # [S, Q, H, D]
     """All-sequences-batched prefill attention.
 
@@ -238,14 +273,16 @@ def _flash_batched_q_chunks(
     if qc == Q:
         return _flash_over_kv_chunks(
             qs, q_pos, slot_ids, seq_lens, k_cache, v_cache,
-            kv_chunk, scale, soft_cap, layer=layer)
+            kv_chunk, scale, soft_cap, layer=layer,
+            k_scale=k_scale, v_scale=v_scale)
 
     def one_q_chunk(_, qi):
         qs_i = jax.lax.dynamic_slice_in_dim(qs, qi * qc, qc, 1)
         qp_i = jax.lax.dynamic_slice_in_dim(q_pos, qi * qc, qc, 1)
         out_i = _flash_over_kv_chunks(
             qs_i, qp_i, slot_ids, seq_lens, k_cache, v_cache,
-            kv_chunk, scale, soft_cap, layer=layer)
+            kv_chunk, scale, soft_cap, layer=layer,
+            k_scale=k_scale, v_scale=v_scale)
         return None, out_i
 
     _, outs = jax.lax.scan(one_q_chunk, None,
@@ -275,6 +312,8 @@ def ragged_paged_attention_chunked(
     token_qpos: jax.Array,     # [T] q slot of each token within its seq
     block_size: int, scale=None, soft_cap=None,
     layer: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Memory-bounded ragged attention (XLA flash recurrence).
 
@@ -294,11 +333,12 @@ def ragged_paged_attention_chunked(
     if Q == 1:
         out = _flash_over_kv_chunks(
             qs, q_pos, slot_ids, seq_lens, k_cache, v_cache,
-            _chunk_size_for(C), scale, soft_cap, layer=layer)  # [S, 1, H, D]
+            _chunk_size_for(C), scale, soft_cap, layer=layer,
+            k_scale=k_scale, v_scale=v_scale)                  # [S, 1, H, D]
     else:
         out = _flash_batched_q_chunks(
             qs, q_pos, slot_ids, seq_lens, k_cache, v_cache,
-            scale, soft_cap, layer=layer)
+            scale, soft_cap, layer=layer, k_scale=k_scale, v_scale=v_scale)
 
     return out[token_seq_ids, token_qpos]       # [T, H, D]
 
@@ -331,6 +371,8 @@ def attention_with_kv_update(
     soft_cap=None,
     backend: str = "auto",
     layer: Optional[jax.Array] = None,   # i32 plane of a stacked cache
+    k_scale: Optional[jax.Array] = None,  # int8 caches: f32 scale planes
+    v_scale: Optional[jax.Array] = None,  # ([num_slots, SW] / [L, slots, SW])
 ):
     """Write this step's KV into the paged cache and attend over it.
 
@@ -344,58 +386,99 @@ def attention_with_kv_update(
     layer loop then carries the whole cache through ``lax.scan`` with zero
     per-layer slice/copy traffic (measured ~10 ms/step of pure HBM copies
     at 1B scale otherwise).
-    Returns (attn_out [T, H, D], k_cache', v_cache').
+
+    ``kv_cache_dtype=int8``: the payload caches are int8 and ``k_scale`` /
+    ``v_scale`` hold per-page-row f32 scales.  New rows are quantized here
+    (symmetric, per row or per KV head — the scale plane's width decides),
+    every reader dequantizes after the gather/DMA, and the flash recurrence
+    itself stays bf16/f32.  Returns a 5-tuple
+    (attn_out, k_cache', v_cache', k_scale', v_scale') in that mode;
+    the classic 3-tuple otherwise.
     """
     backend = resolve_backend(backend)
+    quantized = k_scale is not None
+    T, H, D = q.shape
+    F = k_cache.shape[-1]
+
+    if quantized:
+        sw = k_scale.shape[-1]
+        k_q, k_s = quantize_kv_block(k_new.reshape(T, F), sw)
+        v_q, v_s = quantize_kv_block(v_new.reshape(T, F), sw)
+
+    def _ret(out, k_cache, v_cache, k_scale, v_scale):
+        if quantized:
+            return out, k_cache, v_cache, k_scale, v_scale
+        return out, k_cache, v_cache
 
     qtok_idx = batch.get("qtok_idx")
     # TPU DMA slices need sublane- and lane-aligned pages (see
     # pallas_decode_eligible); anything smaller falls back to the chunked
-    # XLA path instead of failing Mosaic compilation.
+    # XLA path instead of failing Mosaic compilation.  Int8 pages tile
+    # (32, 128), so the quantized kernel additionally needs block_size % 32.
     if backend == "pallas" and soft_cap is None \
-            and pallas_decode_eligible(batch, block_size,
-                                       k_cache.shape[-1]):
+            and pallas_decode_eligible(batch, block_size, F) \
+            and (not quantized or block_size % 32 == 0):
         from llm_d_tpu.ops.pallas.paged_attention import (
             paged_attention_decode_update)
-        T, H, D = q.shape
         rows = qtok_idx[:, 0].clip(0, T - 1)
-        out, k_cache, v_cache = paged_attention_decode_update(
-            q[rows], k_new.reshape(T, -1)[rows].astype(k_cache.dtype),
-            v_new.reshape(T, -1)[rows].astype(v_cache.dtype),
-            k_cache, v_cache, batch["block_tables"], batch["seq_lens"],
-            block_size=block_size,
-            num_kv_heads=k_cache.shape[-1] // D, scale=scale, layer=layer)
-        return out[batch["token_seq_ids"]], k_cache, v_cache
+        if quantized:
+            out, k_cache, v_cache, k_scale, v_scale = \
+                paged_attention_decode_update(
+                    q[rows], k_q[rows], v_q[rows], k_cache, v_cache,
+                    batch["block_tables"], batch["seq_lens"],
+                    block_size=block_size, num_kv_heads=F // D,
+                    scale=scale, layer=layer,
+                    k_scale=k_scale, v_scale=v_scale,
+                    k_scale_new=k_s[rows], v_scale_new=v_s[rows])
+        else:
+            out, k_cache, v_cache = paged_attention_decode_update(
+                q[rows], k_new.reshape(T, F)[rows].astype(k_cache.dtype),
+                v_new.reshape(T, F)[rows].astype(v_cache.dtype),
+                k_cache, v_cache, batch["block_tables"], batch["seq_lens"],
+                block_size=block_size,
+                num_kv_heads=F // D, scale=scale, layer=layer)
+        return _ret(out[batch["token_seq_ids"]],
+                    k_cache, v_cache, k_scale, v_scale)
 
-    k_cache, v_cache = write_kv(
-        k_cache, v_cache, k_new, v_new, batch["slot_mapping"], layer=layer)
+    if quantized:
+        k_cache, v_cache = write_kv(
+            k_cache, v_cache, k_q, v_q, batch["slot_mapping"], layer=layer)
+        k_scale = write_scales(k_scale, k_s, batch["slot_mapping"],
+                               layer=layer)
+        v_scale = write_scales(v_scale, v_s, batch["slot_mapping"],
+                               layer=layer)
+    else:
+        k_cache, v_cache = write_kv(
+            k_cache, v_cache, k_new, v_new, batch["slot_mapping"],
+            layer=layer)
     if backend == "pallas" and qtok_idx is not None \
             and qtok_idx.shape[1] > 1 and block_size % 16 == 0 \
-            and k_cache.shape[-1] % 128 == 0:
+            and F % 128 == 0 and (not quantized or block_size % 32 == 0):
         # Prefill / mixed batches: flash kernel streaming KV pages through
         # VMEM (scatter-then-read; no aliasing needed).  Same lane/sublane
         # gates as the decode kernel.
         from llm_d_tpu.ops.pallas.flash_prefill import flash_prefill_paged
-        D = q.shape[-1]
         qs, q_pos = gather_per_seq_queries(
             q, batch["positions"], qtok_idx)
         out_s = flash_prefill_paged(
             qs, q_pos, k_cache, v_cache,
             batch["block_tables"], batch["seq_lens"],
-            block_size=block_size, num_kv_heads=k_cache.shape[-1] // D,
-            scale=scale, soft_cap=soft_cap, layer=layer)
-        return out_s[batch["token_seq_ids"], batch["token_qpos"]], \
-            k_cache, v_cache
+            block_size=block_size, num_kv_heads=F // D,
+            scale=scale, soft_cap=soft_cap, layer=layer,
+            k_scale=k_scale, v_scale=v_scale)
+        return _ret(out_s[batch["token_seq_ids"], batch["token_qpos"]],
+                    k_cache, v_cache, k_scale, v_scale)
     if backend in ("pallas", "chunked") and qtok_idx is not None:
         out = ragged_paged_attention_chunked(
             q, k_cache, v_cache, batch["token_seq_ids"], batch["positions"],
             batch["block_tables"], batch["seq_lens"], qtok_idx,
             batch["token_qpos"], block_size=block_size,
-            scale=scale, soft_cap=soft_cap, layer=layer)
+            scale=scale, soft_cap=soft_cap, layer=layer,
+            k_scale=k_scale, v_scale=v_scale)
     else:
         out = ragged_paged_attention_reference(
             q, k_cache, v_cache, batch["token_seq_ids"], batch["positions"],
             batch["block_tables"], batch["seq_lens"],
             block_size=block_size, scale=scale, soft_cap=soft_cap,
-            layer=layer)
-    return out, k_cache, v_cache
+            layer=layer, k_scale=k_scale, v_scale=v_scale)
+    return _ret(out, k_cache, v_cache, k_scale, v_scale)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_d_tpu.ops.pallas.quant_util import make_page_dequant
 from llm_d_tpu.utils.jax_compat import CompilerParams
 
 NEG_INF = -1e30
@@ -42,24 +43,26 @@ def _prefill_kernel(
     block_tables_ref,   # [S, B] SMEM
     seq_lens_ref,       # [S]    SMEM
     layer_ref,          # [1]    SMEM
-    # inputs
-    q_ref,              # [1, Qt*H, D] VMEM (fused rows: slot-major, head-minor)
-    qpos_ref,           # [1, Qt*H, 1] VMEM i32 (position per row; pad -> -1)
-    k_hbm,              # [L, num_slots, KVH*D] (ANY)
-    v_hbm,
-    # outputs
-    o_ref,              # [1, Qt*H, D] VMEM
-    # scratch
-    k_buf,              # [2, bs, KVH*D] VMEM
-    v_buf,
-    sems,               # [2, 2] DMA semaphores
-    *,
+    # inputs / outputs / scratch — layout depends on ``quantized``:
+    #   bf16:  q, qpos, k_hbm, v_hbm | o | k_buf, v_buf, sems
+    #   int8:  q, qpos, k_hbm, v_hbm, ks_hbm, vs_hbm | o
+    #          | k_buf, v_buf, ks_buf, vs_buf, sems
+    # (ks/vs are the [L, num_slots, SW] f32 per-page-row scale planes; the
+    #  int8 pages are dequantized in VMEM right after the DMA — this kernel
+    #  only READS the cache, the caller scattered rows + scales already.)
+    *refs,
     block_size: int,
     num_heads: int,
     num_kv_heads: int,
     scale: float,
     soft_cap: float | None,
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, qpos_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         o_ref, k_buf, v_buf, ks_buf, vs_buf, sems) = refs
+    else:
+        (q_ref, qpos_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems) = refs
     s = pl.program_id(0)
     R, D = q_ref.shape[1], q_ref.shape[2]     # R = Qt * H
     H = num_heads
@@ -79,14 +82,25 @@ def _prefill_kernel(
     def page_dma(slot, j):
         b = block_tables_ref[s, j]
         start = pl.multiple_of(b * bs, bs)
-        return (
+        copies = [
             pltpu.make_async_copy(
                 k_hbm.at[li, pl.ds(start, bs)], k_buf.at[slot],
                 sems.at[slot, 0]),
             pltpu.make_async_copy(
                 v_hbm.at[li, pl.ds(start, bs)], v_buf.at[slot],
                 sems.at[slot, 1]),
-        )
+        ]
+        if quantized:
+            copies.append(pltpu.make_async_copy(
+                ks_hbm.at[li, pl.ds(start, bs)], ks_buf.at[slot],
+                sems.at[slot, 2]))
+            copies.append(pltpu.make_async_copy(
+                vs_hbm.at[li, pl.ds(start, bs)], vs_buf.at[slot],
+                sems.at[slot, 3]))
+        return copies
+
+    if quantized:
+        dequant = make_page_dequant(ks_hbm.shape[2], F)
 
     @pl.when(n_pages > 0)
     def _():
@@ -115,9 +129,14 @@ def _prefill_kernel(
             dma.wait()
 
         # bf16 operands, f32 accumulation: 2x MXU rate and no VPU convert
-        # of the page (the flash statistics stay f32).
-        k = k_buf[slot]                                       # [bs, F] bf16
-        v = v_buf[slot]
+        # of the page (the flash statistics stay f32).  Int8 pages pay one
+        # dequant pass for half the DMA bytes.
+        if quantized:
+            k = dequant(k_buf[slot], ks_buf[slot])            # [bs, F] bf16
+            v = dequant(v_buf[slot], vs_buf[slot])
+        else:
+            k = k_buf[slot]                                   # [bs, F] bf16
+            v = v_buf[slot]
         s_hb = jax.lax.dot_general(
             q2.astype(jnp.bfloat16), k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [R, bs]
@@ -180,15 +199,23 @@ def flash_prefill_paged(
     layer: jax.Array | None = None,
     interpret: bool = False,
     q_tile: int | None = None,
+    k_scale: jax.Array | None = None,   # int8 caches: [L, slots, SW] f32
+    v_scale: jax.Array | None = None,   # scale planes (per page row)
 ):
-    """Returns attention outputs [S, Q, H, D] (caches already written)."""
+    """Returns attention outputs [S, Q, H, D] (caches already written —
+    int8 caches with their scale planes scattered by the caller)."""
     S, Q, H, D = qs.shape
     scale = scale if scale is not None else D ** -0.5
+    quantized = k_scale is not None
     squeeze = k_cache.ndim == 2
     if squeeze:
         k_cache = k_cache[None]
         v_cache = v_cache[None]
+        if quantized:
+            k_scale = k_scale[None]
+            v_scale = v_scale[None]
     F = k_cache.shape[2]
+    SW = k_scale.shape[2] if quantized else 0
     Qt = q_tile if q_tile is not None else _pick_q_tile(Q, H, F)
     if Q % Qt:
         raise ValueError(f"q_tile={Qt} must divide Q={Q}")
@@ -199,30 +226,40 @@ def flash_prefill_paged(
     q_fused = qs.reshape(S, Q * H, D)
     qpos_fused = jnp.repeat(q_pos, H, axis=1)[..., None]      # [S, Q*H, 1]
 
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+    in_specs = [
+        pl.BlockSpec((1, Qt * H, D), lambda s, t, *_: (s, t, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Qt * H, 1), lambda s, t, *_: (s, t, 0),
+                     memory_space=pltpu.VMEM),
+        any_spec, any_spec,
+    ] + ([any_spec, any_spec] if quantized else [])
+    scratch = [
+        pltpu.VMEM((2, block_size, F), k_cache.dtype),
+        pltpu.VMEM((2, block_size, F), v_cache.dtype),
+    ]
+    if quantized:
+        scratch += [pltpu.VMEM((2, block_size, SW), jnp.float32),
+                    pltpu.VMEM((2, block_size, SW), jnp.float32)]
+    scratch.append(pltpu.SemaphoreType.DMA((2, 4 if quantized else 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(S, Q // Qt),
-        in_specs=[
-            pl.BlockSpec((1, Qt * H, D), lambda s, t, *_: (s, t, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Qt * H, 1), lambda s, t, *_: (s, t, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, Qt * H, D), lambda s, t, *_: (s, t, 0),
                          memory_space=pltpu.VMEM),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, block_size, F), k_cache.dtype),
-            pltpu.VMEM((2, block_size, F), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _prefill_kernel, block_size=block_size, num_heads=H,
-        num_kv_heads=num_kv_heads, scale=scale, soft_cap=soft_cap)
+        num_kv_heads=num_kv_heads, scale=scale, soft_cap=soft_cap,
+        quantized=quantized)
+    operands = [block_tables, seq_lens, layer_arr, q_fused, qpos_fused,
+                k_cache, v_cache]
+    if quantized:
+        operands += [k_scale, v_scale]
     (out,) = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -230,6 +267,5 @@ def flash_prefill_paged(
         compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(block_tables, seq_lens, layer_arr, q_fused, qpos_fused,
-      k_cache, v_cache)
+    )(*operands)
     return out.reshape(S, Q, H, D)

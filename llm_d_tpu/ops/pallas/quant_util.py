@@ -1,0 +1,41 @@
+"""Shared in-VMEM dequantization for the int8 paged-KV Pallas kernels.
+
+Both the decode kernel (paged_attention.py) and the prefill kernel
+(flash_prefill.py) pull int8 pages plus per-page-row f32 scale pages into
+VMEM and dequantize right after the DMA; this is the one implementation of
+that step so a quantization-layout change lands in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_page_dequant(scale_width: int, row_width: int):
+    """Returns ``dequant(page, scale_page) -> bf16`` for int8 KV pages.
+
+    ``page`` is ``[..., bs, F]`` int8, ``scale_page`` ``[..., bs, SW]``
+    f32.  SW == 1 broadcasts directly; SW > 1 (per-KV-head scales)
+    broadcasts via a tiny ``[bs, SW] @ E[SW, F]`` MXU dot with
+    ``E[s, c] = (c // (F/SW) == s)`` — Mosaic-safe (no lane-offset
+    slicing, no vector reshape).  Everything is traced inside the calling
+    kernel, so the expand matrix is a kernel-resident constant.
+    """
+    sw, f = scale_width, row_width
+    if sw > 1:
+        e_row = jax.lax.broadcasted_iota(jnp.int32, (sw, f), 0)
+        e_col = jax.lax.broadcasted_iota(jnp.int32, (sw, f), 1)
+        expand = (e_col // (f // sw) == e_row).astype(jnp.float32)
+
+    def dequant(page, scale_page):
+        pf = page.astype(jnp.float32)
+        if sw == 1:
+            return (pf * scale_page).astype(jnp.bfloat16)
+        full = jax.lax.dot_general(
+            scale_page, expand,
+            (((scale_page.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return (pf * full).astype(jnp.bfloat16)
+
+    return dequant

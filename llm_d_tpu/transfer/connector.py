@@ -45,9 +45,14 @@ from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 logger = logging.getLogger(__name__)
 
 _MAGIC = 0x4B565442  # "KVTB"
-# magic, num_layers, block_size, num_buffers, nb
-_HEADER = struct.Struct("<IIIII")
-_BUF_HEADER = struct.Struct("<I")   # row width per buffer segment
+# Wire version 2 (kv_cache_dtype era): every buffer segment carries a
+# dtype code so a consumer REJECTS a producer whose cache dtype differs
+# (a bf16 decoder must never silently reinterpret an int8+scales slab —
+# wrong page bytes would decode as garbage attention, not an error).
+_WIRE_VERSION = 2
+# magic, version, num_layers, block_size, num_buffers, nb
+_HEADER = struct.Struct("<IIIIII")
+_BUF_HEADER = struct.Struct("<IB")   # (row width, dtype code) per segment
 
 
 def _next_pow2(n: int, lo: int = 1) -> int:
@@ -453,7 +458,10 @@ def _pack_blocks(engine, block_ids: List[int]) -> bytes:
     ids_dev = jnp.asarray(ids)
     items = _cache_items(engine)
     L = items[0][1].shape[0] if shard is None else items[0][1].shape[1]
-    parts = [_HEADER.pack(_MAGIC, L, bs, len(items), nb)]
+    parts = [_HEADER.pack(_MAGIC, _WIRE_VERSION, L, bs, len(items), nb)]
+    # int8 caches ship int8 rows + their f32 scale planes as ordinary
+    # buffer segments (the scale planes live in engine.kv_cache) — the
+    # P->D payload is ~half the bf16 bytes, the NetKV lever.
     for _, buf in items:
         if shard is None:
             slab = _gather_fn(nb_pad, bs)(buf, ids_dev)
@@ -462,24 +470,29 @@ def _pack_blocks(engine, block_ids: List[int]) -> bytes:
             slab = _gather_fn_stacked(nb_pad, bs, shard)(buf, ids_dev)
             width = buf.shape[3]
         host = np.asarray(jax.device_get(slab))[:, :nb * bs, :]
-        parts.append(_BUF_HEADER.pack(width))
+        parts.append(_BUF_HEADER.pack(
+            width, transport.wire_dtype_code(host.dtype)))
         parts.append(host.tobytes())
     return b"".join(parts)
 
 
 def _scatter_blocks(engine, block_ids: List[int], blob: bytes) -> None:
-    import ml_dtypes
     bs = engine.config.block_size
-    magic, bL, bbs, n_bufs, bnb = _HEADER.unpack_from(blob, 0)
+    magic, ver, bL, bbs, n_bufs, bnb = _HEADER.unpack_from(blob, 0)
     if magic != _MAGIC:
         raise ValueError("bad magic")
+    if ver != _WIRE_VERSION:
+        raise ValueError(
+            f"KV wire version {ver} != {_WIRE_VERSION} (peer running an "
+            "incompatible build; refusing to reinterpret the slab)")
     items = _cache_items(engine)
     shard, local_ids = _resolve_blocks(engine, block_ids)
     L = items[0][1].shape[0] if shard is None else items[0][1].shape[1]
     if (bL, bbs, n_bufs) != (L, bs, len(items)):
         raise ValueError(
             f"slab layout {(bL, bbs, n_bufs)} != cache layout "
-            f"{(L, bs, len(items))}")
+            f"{(L, bs, len(items))} (kv_cache_dtype mismatch between "
+            "producer and consumer changes the buffer set)")
     nb = len(block_ids)
     if bnb < nb:
         raise ValueError(f"slab has {bnb} blocks, need {nb}")
@@ -495,18 +508,29 @@ def _scatter_blocks(engine, block_ids: List[int], blob: bytes) -> None:
     off = _HEADER.size
     for name, buf in items:
         width_have = buf.shape[2] if shard is None else buf.shape[3]
-        (width,) = _BUF_HEADER.unpack_from(blob, off)
+        width, code = _BUF_HEADER.unpack_from(blob, off)
         off += _BUF_HEADER.size
         if width != width_have:
             raise ValueError(
                 f"buffer {name!r}: slab width {width} != cache {width_have}")
+        try:
+            dtype = transport.wire_dtype(code)
+        except transport.TransferError as e:
+            raise ValueError(str(e)) from e
+        if dtype != np.dtype(buf.dtype):
+            # Explicit dtype-mismatch rejection: a bf16 decoder never
+            # silently reinterprets an int8 producer's blocks (or vice
+            # versa) — kv_cache_dtype must match across the P->D pair.
+            raise ValueError(
+                f"buffer {name!r}: producer shipped {dtype} but the local "
+                f"cache is {np.dtype(buf.dtype)} — kv_cache_dtype "
+                "mismatch, refusing to reinterpret")
         count = L * bnb * bs * width
-        payload = np.frombuffer(blob, dtype=ml_dtypes.bfloat16,
-                                offset=off, count=count)
-        off += count * 2
+        payload = np.frombuffer(blob, dtype=dtype, offset=off, count=count)
+        off += count * dtype.itemsize
         slab = payload.reshape(L, bnb * bs, width)[:, :nb * bs, :]
         if nb_pad != nb:
-            pad = np.zeros((L, nb_pad * bs, width), ml_dtypes.bfloat16)
+            pad = np.zeros((L, nb_pad * bs, width), dtype)
             pad[:, :nb * bs, :] = slab
             slab = pad
         fn = (_scatter_fn(nb_pad, bs) if shard is None

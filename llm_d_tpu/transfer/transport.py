@@ -93,6 +93,48 @@ class TransferNotFound(TransferError):
     pass
 
 
+# ---------------------------------------------------------------------------
+# Wire-level dtype tags, shared by every KV payload format riding this
+# transport (the PD slab wire in transfer/connector.py and the offload
+# tier's packed-block format in engine/offload.py).  A one-byte code per
+# buffer segment lets a receiver REJECT a dtype-mismatched producer —
+# an int8+scales cache must never be silently reinterpreted as bf16 rows
+# (kv_cache_dtype=int8 ships half the bytes; the byte count alone would
+# already misparse, but the code makes the failure a named error).
+# ---------------------------------------------------------------------------
+
+WIRE_DTYPE_BF16 = 0
+WIRE_DTYPE_INT8 = 1
+WIRE_DTYPE_F32 = 2
+
+
+def wire_dtype_code(dtype) -> int:
+    """numpy/jax dtype -> wire code; raises on an unshippable dtype."""
+    import ml_dtypes
+    import numpy as np
+    dt = np.dtype(dtype)
+    if dt == np.dtype(ml_dtypes.bfloat16):
+        return WIRE_DTYPE_BF16
+    if dt == np.dtype(np.int8):
+        return WIRE_DTYPE_INT8
+    if dt == np.dtype(np.float32):
+        return WIRE_DTYPE_F32
+    raise TransferError(f"dtype {dt} has no KV wire code")
+
+
+def wire_dtype(code: int):
+    """Wire code -> numpy dtype; raises TransferError on unknown codes
+    (a newer producer's format must fail loudly, not misparse)."""
+    import ml_dtypes
+    import numpy as np
+    table = {WIRE_DTYPE_BF16: np.dtype(ml_dtypes.bfloat16),
+             WIRE_DTYPE_INT8: np.dtype(np.int8),
+             WIRE_DTYPE_F32: np.dtype(np.float32)}
+    if code not in table:
+        raise TransferError(f"unknown KV wire dtype code {code}")
+    return table[code]
+
+
 def _resolve(host: str) -> str:
     """The native client only speaks dotted quads; resolve names here."""
     try:

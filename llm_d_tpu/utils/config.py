@@ -53,6 +53,21 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def env_choice(name: str, default: str, choices: Sequence[str]) -> str:
+    """Enumerated string env knob with invalid-value fallback: an unknown
+    value (``LLMD_KV_CACHE_DTYPE=fp4``) must degrade to the shipped default
+    with a warning, not crash the serving path (see :func:`env_int`)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    val = raw.strip().lower()
+    if val in choices:
+        return val
+    logger.warning("%s=%r is not one of %s; using default %r",
+                   name, raw, tuple(choices), default)
+    return default
+
+
 def deep_merge(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
     """Recursive merge; overlay wins, dicts merge, everything else replaces."""
     out = copy.deepcopy(base)

@@ -16,5 +16,9 @@ for f in scripts/*.sh docs/monitoring/scripts/*.sh; do bash -n "$f"; done
 # suite runs exactly once.
 python -m pytest tests/test_chaos.py -q
 python -m pytest tests/test_lifecycle.py -q
+# int8 paged-KV contract fail-fast (kv_cache_dtype=int8: kernel/fallback
+# parity bounds, offload scale round-trip, wire dtype rejection, pool
+# sizing): a silent KV-numerics or wire-format break must not merge.
+python -m pytest tests/test_kv_quant.py -q
 python -m pytest tests/ --ignore=tests/test_chaos.py \
-    --ignore=tests/test_lifecycle.py
+    --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py

@@ -119,12 +119,125 @@ def _recommend(points: list) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Paged-attention sweep (context-length x cache dtype): the decode kernel's
+# bf16-vs-int8 crossover table, the KV-bytes analogue of the MoE table
+# above.  Int8 halves the per-page DMA bytes but pays a VPU dequant pass
+# per page, so the win grows with context (more pages per step) — this
+# sweep measures where it starts on a real chip; --interpret runs the same
+# glue on CPU for tier-1 (timings flagged invalid).
+# ---------------------------------------------------------------------------
+
+def _paged_case(key, S, KVH, D, bs, ctx, num_layers=2, plane=1):
+    """Engine-shaped decode case over a stacked cache at context ``ctx``."""
+    import numpy as np
+    rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 1 << 30)))
+    H = KVH * 4
+    F = KVH * D
+    B = -(-ctx // bs)
+    num_blocks = S * B + 1
+    shape = (num_layers, num_blocks * bs, F)
+    k_cache = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    v_cache = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+    perm = rng.permutation(num_blocks - 1)[: S * B] + 1
+    bt = jnp.asarray(perm.reshape(S, B), jnp.int32)
+    lens = jnp.asarray(
+        np.clip(ctx - rng.integers(0, bs, S), 1, ctx), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.bfloat16)
+    k_new = jnp.asarray(rng.standard_normal((S, F)), jnp.bfloat16)
+    v_new = jnp.asarray(rng.standard_normal((S, F)), jnp.bfloat16)
+    return q, k_new, v_new, k_cache, v_cache, bt, lens, \
+        jnp.asarray(plane, jnp.int32)
+
+
+def _paged_thunks(case, bs, KVH, interpret):
+    """dtype -> thunk running the REAL decode kernel at that cache dtype."""
+    from llm_d_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode_update)
+    from llm_d_tpu.ops.quant import quantize_kv_block
+    q, k_new, v_new, k_cache, v_cache, bt, lens, plane = case
+
+    def bf16():
+        return paged_attention_decode_update(
+            q, k_new, v_new, k_cache, v_cache, bt, lens, block_size=bs,
+            num_kv_heads=KVH, layer=plane, interpret=interpret)[0]
+
+    kq, ks = quantize_kv_block(k_cache, 1)
+    vq, vs = quantize_kv_block(v_cache, 1)
+    knq, kns = quantize_kv_block(k_new, 1)
+    vnq, vns = quantize_kv_block(v_new, 1)
+
+    def int8():
+        return paged_attention_decode_update(
+            q, knq, vnq, kq, vq, bt, lens, block_size=bs,
+            num_kv_heads=KVH, layer=plane, interpret=interpret,
+            k_scale=ks, v_scale=vs, k_scale_new=kns, v_scale_new=vns)[0]
+
+    return {"bf16": bf16, "int8": int8}
+
+
+def run_paged(args) -> dict:
+    if args.interpret:
+        S, KVH, D, bs = 4, 2, 64, 32
+        sweep = [64, 128]
+        iters = args.iters or 1
+    else:
+        S, KVH, D, bs = 64, 8, 128, 64       # llama3-1b bench shapes
+        sweep = [256, 512, 1024, 2048, 4096]
+        iters = args.iters or 10
+    if args.ctx_sweep:
+        sweep = [int(t) for t in args.ctx_sweep.split(",") if t]
+    points = []
+    for i, ctx in enumerate(sweep):
+        case = _paged_case(jax.random.PRNGKey(i), S, KVH, D, bs, ctx)
+        thunks = _paged_thunks(case, bs, KVH, args.interpret)
+        from llm_d_tpu.engine.engine import kv_bytes_per_token
+        F = KVH * D
+        layout = {"k": F, "v": F}
+        ms = {name: round(_time_ms(t, iters), 3)
+              for name, t in thunks.items()}
+        points.append({
+            "ctx": ctx, "ms": ms,
+            # Per-step KV bytes each dtype streams at this context (pages
+            # + int8 scale plane, same accounting the engine's pool sizing
+            # charges) — the denominator of the crossover.
+            "kv_mb_per_step": {
+                dtype: round(
+                    S * ctx * kv_bytes_per_token(layout, dtype, 1) / 1e6, 3)
+                for dtype in ("bf16", "int8")
+            }})
+    crossover = None
+    for p in points:
+        if p["ms"]["int8"] <= p["ms"]["bf16"]:
+            crossover = p["ctx"]
+            break
+    return {
+        "mode": "paged_attention",
+        "backend": jax.default_backend(),
+        "interpret": args.interpret,
+        "timings_valid": not args.interpret,
+        "shapes": {"S": S, "KVH": KVH, "D": D, "block_size": bs},
+        "iters": iters,
+        "points": points,
+        "crossover": {"int8_faster_from_ctx": crossover,
+                      "LLMD_KV_CACHE_DTYPE":
+                          "int8" if crossover is not None else "bf16"},
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interpret", action="store_true",
                     help="tiny shapes through the Pallas interpreter "
                          "(CPU CI: exercises every kernel's dispatch "
                          "glue; timings not meaningful)")
+    ap.add_argument("--paged", action="store_true",
+                    help="run the paged-attention context x dtype sweep "
+                         "(bf16 vs int8 KV cache) instead of the MoE "
+                         "kernel family")
+    ap.add_argument("--ctx-sweep", type=str, default=None,
+                    help="paged mode: comma-separated context lengths "
+                         "(default: 256..4096 on chip, 64,128 interpreted)")
     ap.add_argument("--t-sweep", type=str, default=None,
                     help="comma-separated token counts (default: "
                          "64..8192 on chip, 8..64 interpreted)")
@@ -140,6 +253,15 @@ def main(argv=None) -> int:
     ap.add_argument("--out", type=str, default=None,
                     help="also write the JSON document to this path")
     args = ap.parse_args(argv)
+
+    if args.paged:
+        doc = run_paged(args)
+        text = json.dumps(doc)
+        print(text)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
+        return 0
 
     if args.interpret:
         E, H, I, k = 8, 256, 128, 2

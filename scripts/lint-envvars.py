@@ -23,11 +23,11 @@ PREFIXES = ("LLMD_", "LWS_")
 READ_RE = re.compile(
     r"environ(?:\.get\(|\[)\s*\"((?:%s)[A-Z0-9_]+)\"" %
     "|".join(PREFIXES))
-# The config helpers (env_int / env_float, invalid-value fallback) are the
-# blessed way to read a knob — their call sites ARE reads, and a knob read
-# only through them must still be documented.
+# The config helpers (env_int / env_float / env_choice, invalid-value
+# fallback) are the blessed way to read a knob — their call sites ARE
+# reads, and a knob read only through them must still be documented.
 HELPER_RE = re.compile(
-    r"env_(?:int|float)\(\s*\"((?:%s)[A-Z0-9_]+)\"" % "|".join(PREFIXES))
+    r"env_(?:int|float|choice)\(\s*\"((?:%s)[A-Z0-9_]+)\"" % "|".join(PREFIXES))
 DOC_RE = re.compile(r"^\|\s*`((?:%s)[A-Z0-9_]+)`" % "|".join(PREFIXES),
                     re.M)
 YAML_ENV_RE = re.compile(r"name:\s*((?:%s)[A-Z0-9_]+)" % "|".join(PREFIXES))

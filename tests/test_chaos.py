@@ -534,6 +534,40 @@ def test_kv_pull_drops_30pct_all_requests_survive(pd_engines, inject):
         consumer.kv_connector.close()
 
 
+def test_kv_pull_drops_with_int8_kv_cache(inject):
+    """Resilience paths are dtype-clean under kv_cache_dtype=int8: with
+    injected pull drops, the retry budget and recompute fallback work over
+    the int8+scales wire (versioned slab, half the bytes) exactly as over
+    bf16, and every request decodes to parity with an int8 baseline."""
+    kw = dict(ENGINE_KW, kv_cache_dtype="int8")
+    baseline = EngineCore(EngineConfig(**kw))
+    producer = EngineCore(EngineConfig(**kw), params=baseline.params)
+    producer.kv_connector = TpuConnector(
+        KVConnectorConfig(kv_role="kv_producer", host="127.0.0.1"))
+    inj = inject()
+    inj.add_rule("kv.pull", probability=0.3)
+    consumer = EngineCore(EngineConfig(**kw), params=baseline.params)
+    consumer.kv_connector = TpuConnector(KVConnectorConfig(
+        kv_role="kv_consumer", kv_load_failure_policy="recompute",
+        timeout_ms=2000, pull_retries=2, pull_backoff_s=0.01))
+    try:
+        prompts = {f"kvq8-{i}": [5 + i, 1, 4, 1, 5, 9, 2 + i]
+                   for i in range(6)}
+        expected = {rid: baseline.generate(
+            [greedy_req("b" + rid, p, 4)])["b" + rid]
+            for rid, p in prompts.items()}
+        for rid, prompt in prompts.items():
+            params = _remote_prefill(producer, rid, prompt)
+            dreq = greedy_req(rid, prompt, 4, do_remote_prefill=True,
+                              kv_transfer_params=params)
+            out = consumer.generate([dreq])
+            assert out[rid] == expected[rid], rid
+        assert inj.stats()["kv.pull"]["fired"] >= 1
+    finally:
+        consumer.kv_connector.close()
+        producer.kv_connector.close()
+
+
 def test_kv_pull_total_outage_terminates_under_policy_fail(
         pd_engines, inject):
     """100% pull drops + policy=fail: the request ABORTS loudly (bounded
