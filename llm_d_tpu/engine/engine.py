@@ -34,7 +34,8 @@ from llm_d_tpu.ops import sampling as sampling_ops
 from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
 from llm_d_tpu.parallel.sharding import logical_to_sharding, shard_pytree
 from llm_d_tpu.ops.quant import (
-    KV_CACHE_DTYPES, KV_SCALE_GRANULARITIES, kv_scale_width)
+    KV_CACHE_DTYPES, KV_SCALE_GRANULARITIES, MLA_LATENT_DTYPES,
+    kv_scale_width)
 from llm_d_tpu.utils.config import env_choice
 from llm_d_tpu.utils.faultinject import get_injector
 from llm_d_tpu.utils.metrics import EngineMetrics
@@ -142,6 +143,13 @@ class EngineConfig:
     # "head" (one per KV head's D-block — finer, shard-local under
     # tp-sharded KV heads).  None resolves LLMD_KV_SCALE_GRAN.
     kv_scale_granularity: Optional[str] = None
+    # MLA latent-row cache dtype gate, separate from the dense KV knob:
+    # "auto" (follow kv_cache_dtype — the default), "bf16" (pin the latent
+    # to bf16 even under kv_cache_dtype=int8 — the escape hatch if a
+    # model's absorption accuracy falls outside the tested bound) or
+    # "int8" (quantize the latent even when the config default is bf16).
+    # None resolves LLMD_MLA_LATENT_DTYPE.  Ignored for non-MLA models.
+    mla_latent_dtype: Optional[str] = None
     # Auto-size the block pool from an HBM budget instead of num_blocks:
     # dtype-aware (int8 fits ~2x the blocks), see derive_num_blocks.
     kv_cache_hbm_bytes: Optional[int] = None
@@ -179,6 +187,21 @@ class EngineCore:
             raise ValueError(
                 f"unknown kv_cache_dtype {self.kv_cache_dtype!r} "
                 f"(choices: {KV_CACHE_DTYPES})")
+        if c.use_mla:
+            # The MLA latent row IS the whole cache (576 values/token vs
+            # 32768 materialized for V3), so its dtype gate resolves the
+            # effective kv_cache_dtype for the engine: "auto" follows the
+            # dense knob, "bf16"/"int8" pin the latent explicitly (the
+            # escape hatch / force lever around the absorption-accuracy
+            # contract tests/test_mla_quant.py gates).
+            latent = config.mla_latent_dtype or env_choice(
+                "LLMD_MLA_LATENT_DTYPE", "auto", MLA_LATENT_DTYPES)
+            if latent not in MLA_LATENT_DTYPES:
+                raise ValueError(
+                    f"unknown mla_latent_dtype {latent!r} "
+                    f"(choices: {MLA_LATENT_DTYPES})")
+            if latent != "auto":
+                self.kv_cache_dtype = latent
         self.kv_quantized = self.kv_cache_dtype == "int8"
         gran = config.kv_scale_granularity or env_choice(
             "LLMD_KV_SCALE_GRAN", "token", KV_SCALE_GRANULARITIES)
@@ -187,17 +210,15 @@ class EngineCore:
                 f"unknown kv_scale_granularity {gran!r} "
                 f"(choices: {KV_SCALE_GRANULARITIES})")
         self.kv_scale_granularity = gran
-        if self.kv_quantized and c.use_mla:
-            # The MLA latent row IS the cache-compression play (576 values
-            # vs 32768 materialized for V3) and its kernels attend over the
-            # latent directly; int8 targets the dense K/V byte stream.
-            # Serving MLA silently in bf16 while the operator believes the
-            # cache was halved would be a misconfiguration, not a fallback.
-            raise ValueError(
-                "kv_cache_dtype='int8' quantizes the dense K/V cache; "
-                f"model {c.name!r} uses MLA (latent cache stays bf16)")
-        self.kv_scale_width = (kv_scale_width(c.num_kv_heads, gran)
-                               if self.kv_quantized else 0)
+        # MLA's latent row is MQA-shared (no per-head substructure), so its
+        # scale plane is always one f32 per row; dense K/V may refine to
+        # per-KV-head scales under LLMD_KV_SCALE_GRAN=head.
+        if not self.kv_quantized:
+            self.kv_scale_width = 0
+        elif c.use_mla:
+            self.kv_scale_width = 1
+        else:
+            self.kv_scale_width = kv_scale_width(c.num_kv_heads, gran)
         if config.kv_cache_hbm_bytes:
             # Dtype-aware pool sizing: same budget, ~2x the int8 blocks.
             # The budget is PER DEVICE: stacked (SPMD dp) engines split the

@@ -83,10 +83,15 @@ def mla_attention_block(
     block_size: int,
     attn_backend: str,
     layer: jax.Array,
-) -> Tuple[jax.Array, jax.Array]:
+    kv_scale: jax.Array = None,   # int8 latent: [L, slots, SW] f32 scales
+) -> Tuple[jax.Array, ...]:
     """Weight-absorbed MLA over the paged latent cache.
 
-    Returns (attn_out [T, Hm], kv_cache')."""
+    Returns (attn_out [T, Hm], kv_cache') — plus kv_scale' appended when
+    the latent cache is int8-quantized (``kv_scale`` given: the payload
+    cache holds int8 rows, each with one symmetric f32 scale; every reader
+    dequantizes before the two absorbed-weight dots, so kernel and XLA
+    fallback share one dequantize-then-attend numerics contract)."""
     c = config
     T = x.shape[0]
     H = c.num_heads
@@ -136,56 +141,99 @@ def mla_attention_block(
         row = jnp.pad(row, ((0, 0), (0, pad)))
         q_eff = jnp.pad(q_eff, ((0, 0), (0, 0), (0, pad)))
 
+    quantized = kv_scale is not None
+    if quantized:
+        # One symmetric f32 scale per latent row (SW = 1 — the row is
+        # MQA-shared, there is no per-head substructure to refine over);
+        # pad columns quantize to exact zeros, so lane padding stays
+        # score-neutral under int8 too.
+        from llm_d_tpu.ops.quant import quantize_kv_block
+        row_q, row_s = quantize_kv_block(row, kv_scale.shape[-1])
+
+    def _ret(out_proj, kv_cache, kv_scale):
+        if quantized:
+            return out_proj, kv_cache, kv_scale
+        return out_proj, kv_cache
+
     backend = A.resolve_backend(attn_backend)
     qtok_idx = batch["qtok_idx"]
-    if backend == "pallas" and A.pallas_decode_eligible(
+    # Int8 pages tile (32, 128): the quantized kernels additionally need
+    # block_size % 32 (same gate as the dense paged kernels).
+    kernel_ok = not quantized or block_size % 32 == 0
+    if backend == "pallas" and kernel_ok and A.pallas_decode_eligible(
             batch, block_size, F_cache):
         # Decode hot path: single-buffer MQA kernel — each latent page is
         # DMA'd once and used for both the score and value dots, with the
         # new row spliced in place (ops/pallas/mla_attention.py).
         from llm_d_tpu.ops.pallas.mla_attention import mla_paged_decode_update
+        from llm_d_tpu.utils.config import env_int
         rows_idx = qtok_idx[:, 0].clip(0, T - 1)
-        out, kv_cache = mla_paged_decode_update(
-            q_eff[rows_idx], row[rows_idx], kv_cache,
-            batch["block_tables"], batch["seq_lens"],
-            block_size=block_size, scale=scale, layer=layer)
+        # Per-batch-size retune knob: override the auto sequence grouping
+        # (0 = auto).  The group trades grid-program launch overhead
+        # against VMEM residency; re-derive on chip per batch size with
+        # scripts/kernel_bench.py --mla.  Env-knob contract: a value that
+        # does not divide THIS program's sequence bucket (S varies with
+        # load) degrades to auto instead of crashing the serving path.
+        sg = env_int("LLMD_MLA_SEQ_GROUP", 0)
+        S_b = qtok_idx.shape[0]
+        sg = sg if sg >= 1 and S_b % sg == 0 else None
+        if quantized:
+            out, kv_cache, kv_scale = mla_paged_decode_update(
+                q_eff[rows_idx], row_q[rows_idx], kv_cache,
+                batch["block_tables"], batch["seq_lens"],
+                block_size=block_size, scale=scale, layer=layer,
+                seq_group=sg, kv_scale=kv_scale,
+                row_scale_new=row_s[rows_idx])
+        else:
+            out, kv_cache = mla_paged_decode_update(
+                q_eff[rows_idx], row[rows_idx], kv_cache,
+                batch["block_tables"], batch["seq_lens"],
+                block_size=block_size, scale=scale, layer=layer,
+                seq_group=sg)
         out_lat = out[batch["token_seq_ids"]][..., :R].astype(jnp.float32)
-    elif backend == "pallas" and qtok_idx.shape[1] > 1 \
+    elif backend == "pallas" and kernel_ok and qtok_idx.shape[1] > 1 \
             and block_size % 16 == 0 and F_cache % 128 == 0:
         # Prefill / mixed batches: MLA flash kernel — the latent page is
         # DMA'd once per tile and serves both the score and value dots
         # (ops/pallas/mla_prefill.py; the chunked XLA path below cost
         # ~90% of the MoE prefill step, BENCH_r04 Weak #4).
         from llm_d_tpu.ops.pallas.mla_prefill import mla_flash_prefill
+        wr = (row_q if quantized else row).reshape(T, 1, F_cache)
         kv_cache, _ = A.write_kv(
-            kv_cache, kv_cache, row.reshape(T, 1, F_cache),
-            row.reshape(T, 1, F_cache),
-            batch["slot_mapping"], layer=layer)
+            kv_cache, kv_cache, wr, wr, batch["slot_mapping"], layer=layer)
+        if quantized:
+            kv_scale = A.write_scales(
+                kv_scale, row_s, batch["slot_mapping"], layer=layer)
         qs, q_pos = A.gather_per_seq_queries(
             q_eff, batch["positions"], qtok_idx)            # [S, Q, H, F]
         out_s = mla_flash_prefill(
             qs, q_pos, kv_cache, batch["block_tables"], batch["seq_lens"],
-            block_size=block_size, scale=scale, layer=layer)
+            block_size=block_size, scale=scale, layer=layer,
+            kv_scale=kv_scale)
         out_lat = out_s[batch["token_seq_ids"], batch["token_qpos"]]
         out_lat = out_lat[..., :R].astype(jnp.float32)      # attended c_kv
     else:
         # KVH=1 (every head reads the same latent row); the v-cache aliases
         # the k-cache — attended "values" are the row's first R columns.
+        wr = (row_q if quantized else row).reshape(T, 1, F_cache)
         kv_cache, _ = A.write_kv(
-            kv_cache, kv_cache, row.reshape(T, 1, F_cache),
-            row.reshape(T, 1, F_cache),
-            batch["slot_mapping"], layer=layer)
+            kv_cache, kv_cache, wr, wr, batch["slot_mapping"], layer=layer)
+        if quantized:
+            kv_scale = A.write_scales(
+                kv_scale, row_s, batch["slot_mapping"], layer=layer)
         out_lat = A.ragged_paged_attention_chunked(
             q_eff, kv_cache, kv_cache, batch["token_seq_ids"],
             batch["positions"], batch["block_tables"], batch["seq_lens"],
             qtok_idx, batch["token_qpos"], block_size=block_size,
-            scale=scale, layer=layer)                       # [T, H, F_cache]
+            scale=scale, layer=layer, k_scale=kv_scale,
+            v_scale=kv_scale)                               # [T, H, F_cache]
         out_lat = out_lat[..., :R].astype(jnp.float32)      # attended c_kv
 
     # --- absorb W_uv: latent -> per-head value space, then output proj ---
     attn = jnp.einsum("thr,rhv->thv", out_lat,
                       w_uv.astype(jnp.float32)).astype(x.dtype)
-    return L.linear(attn.reshape(T, H * vdim), lp["o_proj"]), kv_cache
+    return _ret(L.linear(attn.reshape(T, H * vdim), lp["o_proj"]),
+                kv_cache, kv_scale)
 
 
 def mla_sharding_rules():

@@ -1,0 +1,164 @@
+"""Accuracy harness for the int8 MLA latent cache (per-absorption bounds).
+
+MLA's serving formulation absorbs the two latent up-projections into the
+surrounding matmuls, which means quantizing the cached latent row changes
+the operands of TWO different dots (docs/perf-notes-r8.md called for
+exactly this harness before lifting the int8+MLA restriction):
+
+  1. **Score absorption** (W_uk into the queries): the score is one dot of
+     the absorbed query ``[q_nope @ W_uk | q_pe]`` against the cached row
+     ``[c_kv | k_pe]`` — quantization error enters PRE-softmax, where it
+     is amplified by the absorbed query norm and then squashed by softmax.
+  2. **Value absorption** (W_uv on the output): the attended latent (a
+     softmax-weighted sum of cached rows) is projected by W_uv —
+     quantization error enters POST-softmax, averaged across the context.
+
+The harness measures both terms separately (and end-to-end) against the
+bf16 latent on REAL rows — harvest them from a serving engine's cache
+with :func:`harvest_latent_rows` — so the error bound the engine gate
+quotes is a measured property of actual latent statistics, not of a
+synthetic N(0,1) proxy.  ``tests/test_mla_quant.py`` asserts the bounds
+on a traced tiny-MLA engine and fails the merge gate when they drift
+(the AQT-style quantized-matmul harness shape).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_d_tpu.ops import layers as L
+from llm_d_tpu.ops.quant import dequantize_kv_block, quantize_kv_block
+
+# Documented (and test-gated) relative-RMS bounds for the int8 latent with
+# one symmetric scale per 576-wide row: per-element error <= amax/254 of
+# the row; both absorptions land well inside these on real traces.
+SCORE_REL_BOUND = 2e-2
+VALUE_REL_BOUND = 2e-2
+
+
+def harvest_latent_rows(engine, max_rows: Optional[int] = None) -> np.ndarray:
+    """Real latent rows from a bf16 MLA engine's cache after traffic.
+
+    Returns ``[N, F]`` f32 — every written (non-zero) slot row across all
+    layer planes (block 0 is the trash block and zero rows are skipped, so
+    only rows decode steps actually produced survive).  Run requests
+    through the engine first; this is the "real decode traces" half of the
+    harness."""
+    kv = np.asarray(jax.device_get(engine.kv_cache["kv"]), np.float32)
+    rows = kv.reshape(-1, kv.shape[-1])
+    rows = rows[np.abs(rows).max(axis=-1) > 0]
+    if max_rows is not None:
+        rows = rows[:max_rows]
+    return rows
+
+
+def absorbed_queries(lp: Dict, config, x: jax.Array,
+                     positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """The real absorbed-query path of ``models/mla.py`` for one layer.
+
+    ``lp`` holds that layer's (unstacked) MLA params, ``x`` ``[T, Hm]``
+    hidden states, ``positions`` ``[T]``.  Returns (q_eff ``[T, H, F]``
+    f32 — W_uk already absorbed, rope applied — and w_uv ``[R, H, V]``
+    f32) so the harness scores with exactly the operands serving uses."""
+    c = config
+    T = x.shape[0]
+    H = c.num_heads
+    nope, rope = c.qk_nope_head_dim, c.qk_rope_head_dim
+    R = c.kv_lora_rank
+    if "q_a_proj" in lp:
+        cq = L.rms_norm(L.linear(x, lp["q_a_proj"]), lp["q_a_norm"],
+                        c.rms_norm_eps)
+        q = L.linear(cq, lp["q_b_proj"]).reshape(T, H, nope + rope)
+    else:
+        q = L.linear(x, lp["q_proj"]).reshape(T, H, nope + rope)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    cos, sin = L.rope_cos_sin(positions, rope, c.rope_theta)
+    q_pe = L.apply_rope(q_pe, cos, sin)
+    w_kv = lp["kv_b_proj"].reshape(R, H, nope + c.v_head_dim)
+    w_uk = w_kv[..., :nope].astype(jnp.float32)
+    w_uv = w_kv[..., nope:].astype(jnp.float32)
+    q_lat = jnp.einsum("thn,rhn->thr", q_nope.astype(jnp.float32), w_uk)
+    q_eff = jnp.concatenate([q_lat, q_pe.astype(jnp.float32)], axis=-1)
+    return q_eff, w_uv
+
+
+def _rel_rms(err: np.ndarray, ref: np.ndarray) -> float:
+    return float(np.sqrt(np.mean(err ** 2))
+                 / max(np.sqrt(np.mean(ref ** 2)), 1e-12))
+
+
+def absorption_error_report(rows: np.ndarray, q_eff: jax.Array,
+                            w_uv: jax.Array, kv_lora_rank: int,
+                            scale: Optional[float] = None) -> Dict:
+    """Per-absorption int8-vs-bf16 error over real latent rows.
+
+    ``rows`` ``[N, F]`` (lane padding allowed — pad columns quantize to
+    exact zeros), ``q_eff`` ``[T, H, F']`` absorbed queries (F' <= F;
+    sliced/padded to match), ``w_uv`` ``[R, H, V]``.  Treats the N rows
+    as one shared context: scores, softmax and the attended-latent value
+    projection are computed under the bf16 and the quantized latent, and
+    the error is isolated per absorption:
+
+      - ``score``:   s_bf16 vs s_int8 (pre-softmax — W_uk absorption)
+      - ``value``:   W_uv(p_bf16 @ rows_bf16) vs W_uv(p_bf16 @ rows_int8)
+                     (probabilities held fixed — W_uv absorption only)
+      - ``end_to_end``: both quantization entries live at once (what the
+                     serving path actually computes)
+
+    Returns nested dicts of ``max_abs`` / ``rel_rms`` per term plus the
+    tested bounds, for the docs table and the gate assertions."""
+    R = kv_lora_rank
+    F = rows.shape[-1]
+    q = np.asarray(q_eff, np.float32)
+    if q.shape[-1] < F:
+        q = np.pad(q, ((0, 0), (0, 0), (0, F - q.shape[-1])))
+    scale = scale if scale is not None else 1.0
+    rows_bf = np.asarray(
+        jnp.asarray(rows).astype(jnp.bfloat16), np.float32)   # serve dtype
+    rq, rs = quantize_kv_block(jnp.asarray(rows, jnp.float32), 1)
+    rows_q8 = np.asarray(dequantize_kv_block(rq, rs, jnp.float32))
+
+    def softmax(s):
+        m = s.max(axis=-1, keepdims=True)
+        p = np.exp(s - m)
+        return p / p.sum(axis=-1, keepdims=True)
+
+    wv = np.asarray(w_uv, np.float32)
+
+    def attend(rows_for_scores, rows_for_values):
+        s = np.einsum("thf,nf->thn", q * scale, rows_for_scores)
+        p = softmax(s)
+        o = np.einsum("thn,nr->thr", p, rows_for_values[:, :R])
+        v = np.einsum("thr,rhv->thv", o, wv)
+        return s, v
+
+    s_bf, v_bf = attend(rows_bf, rows_bf)
+    s_q8, v_q8 = attend(rows_q8, rows_q8)
+    # Value-absorption isolation: bf16 scores/probabilities, int8 values.
+    _, v_mix = attend(rows_bf, rows_q8)
+
+    report = {
+        "rows": int(rows.shape[0]),
+        "score": {
+            "max_abs": float(np.abs(s_q8 - s_bf).max()),
+            "rel_rms": _rel_rms(s_q8 - s_bf, s_bf),
+            "bound_rel_rms": SCORE_REL_BOUND,
+        },
+        "value": {
+            "max_abs": float(np.abs(v_mix - v_bf).max()),
+            "rel_rms": _rel_rms(v_mix - v_bf, v_bf),
+            "bound_rel_rms": VALUE_REL_BOUND,
+        },
+        "end_to_end": {
+            "max_abs": float(np.abs(v_q8 - v_bf).max()),
+            "rel_rms": _rel_rms(v_q8 - v_bf, v_bf),
+        },
+    }
+    report["within_bounds"] = bool(
+        report["score"]["rel_rms"] <= SCORE_REL_BOUND
+        and report["value"]["rel_rms"] <= VALUE_REL_BOUND)
+    return report

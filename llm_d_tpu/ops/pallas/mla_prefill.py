@@ -23,6 +23,13 @@ Causality bounds the page loop per tile; pad query rows carry position
 -1 and produce zeros.  KV rows for the tokens being computed are
 scattered by the caller (write_kv) BEFORE the kernel runs — read-only,
 no aliasing contract.
+
+``kv_cache_dtype=int8`` (the latent cache): the page payload is int8 and
+each page's per-row f32 scales ride a parallel DMA chain from the sibling
+scale plane (read-side of the same treatment the decode kernel gets); the
+page is dequantized in VMEM after the DMA and both dots read bf16.  The
+caller quantizes and scatters the new rows + scales before the kernel
+runs, exactly like the bf16 scatter-then-read contract.
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_d_tpu.ops.pallas.quant_util import make_page_dequant
 from llm_d_tpu.utils.jax_compat import CompilerParams
 
 NEG_INF = -1e30
@@ -44,19 +52,19 @@ def _mla_prefill_kernel(
     block_tables_ref,   # [S, B] SMEM
     seq_lens_ref,       # [S]    SMEM
     layer_ref,          # [1]    SMEM
-    # inputs
-    q_ref,              # [1, Qt*H, F] VMEM (fused rows: slot-major, head-minor)
-    qpos_ref,           # [1, Qt*H, 1] VMEM i32 (position per row; pad -> -1)
-    kv_hbm,             # [L, num_slots, F] (ANY) — the latent paged cache
-    # outputs
-    o_ref,              # [1, Qt*H, F] VMEM
-    # scratch
-    kv_buf,             # [2, bs, F] VMEM — shared by score AND value dots
-    sems,               # [2] DMA semaphores
-    *,
+    # inputs / outputs / scratch — layout depends on ``quantized``:
+    #   bf16: q, qpos, kv_hbm | o | kv_buf, sems
+    #   int8: q, qpos, kv_hbm, ks_hbm | o | kv_buf, ks_buf, sems
+    *refs,
     block_size: int,
     scale: float,
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, qpos_ref, kv_hbm, ks_hbm,
+         o_ref, kv_buf, ks_buf, sems) = refs
+    else:
+        (q_ref, qpos_ref, kv_hbm, o_ref, kv_buf, sems) = refs
     s = pl.program_id(0)
     bs = block_size
     li = layer_ref[0]
@@ -68,15 +76,26 @@ def _mla_prefill_kernel(
     live = jnp.minimum(seq_len, qmax + 1)
     n_pages = pl.cdiv(jnp.maximum(live, 0), bs)
 
+    if quantized:
+        SW = ks_buf.shape[-1]
+        dequant = make_page_dequant(SW, q_ref.shape[2])
+
     def page_dma(slot, j):
         b = block_tables_ref[s, j]
         start = pl.multiple_of(b * bs, bs)
-        return pltpu.make_async_copy(
-            kv_hbm.at[li, pl.ds(start, bs)], kv_buf.at[slot], sems.at[slot])
+        copies = [pltpu.make_async_copy(
+            kv_hbm.at[li, pl.ds(start, bs)], kv_buf.at[slot],
+            sems.at[slot, 0])]
+        if quantized:
+            copies.append(pltpu.make_async_copy(
+                ks_hbm.at[li, pl.ds(start, bs)], ks_buf.at[slot],
+                sems.at[slot, 1]))
+        return copies
 
     @pl.when(n_pages > 0)
     def _():
-        page_dma(0, 0).start()
+        for dma in page_dma(0, 0):
+            dma.start()
 
     # bf16 operands, f32 accumulation (flash statistics stay f32).
     q2 = (q_ref[0].astype(jnp.float32) * scale).astype(jnp.bfloat16)
@@ -87,10 +106,15 @@ def _mla_prefill_kernel(
 
         @pl.when(j + 1 < n_pages)
         def _():
-            page_dma((j + 1) % 2, j + 1).start()
+            for dma in page_dma((j + 1) % 2, j + 1):
+                dma.start()
 
-        page_dma(slot, j).wait()
-        kv = kv_buf[slot]                                     # [bs, F] bf16
+        for dma in page_dma(slot, j):
+            dma.wait()
+        if quantized:
+            kv = dequant(kv_buf[slot], ks_buf[slot])          # [bs, F] bf16
+        else:
+            kv = kv_buf[slot]                                 # [bs, F] bf16
         s_hb = jax.lax.dot_general(
             q2, kv, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [R, bs]
@@ -149,16 +173,22 @@ def mla_flash_prefill(
     layer: jax.Array | None = None,
     interpret: bool = False,
     q_tile: int | None = None,
+    kv_scale: jax.Array | None = None,   # int8 latent: [L, slots, SW] f32
 ):
-    """Returns attended latent rows [S, Q, H, F] (cache already written).
+    """Returns attended latent rows [S, Q, H, F] (cache already written —
+    including, for the int8 latent, the new rows' scales in ``kv_scale``).
 
     The caller slices the first ``kv_lora_rank`` columns (attended values)
     and absorbs W_uv, exactly as with the chunked path."""
     S, Q, H, F = qs.shape
+    quantized = kv_scale is not None
     squeeze = kv_cache.ndim == 2
     if squeeze:
         kv_cache = kv_cache[None]
+        if quantized:
+            kv_scale = kv_scale[None]
     assert kv_cache.shape[2] == F, (kv_cache.shape, F)
+    SW = kv_scale.shape[2] if quantized else 0
     Qt = q_tile if q_tile is not None else _pick_q_tile(Q, H, F)
     if Q % Qt:
         raise ValueError(f"q_tile={Qt} must divide Q={Q}")
@@ -169,27 +199,36 @@ def mla_flash_prefill(
     q_fused = qs.reshape(S, Q * H, F)
     qpos_fused = jnp.repeat(q_pos, H, axis=1)[..., None]      # [S, Q*H, 1]
 
+    in_specs = [
+        pl.BlockSpec((1, Qt * H, F), lambda s, t, *_: (s, t, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Qt * H, 1), lambda s, t, *_: (s, t, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    if quantized:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+    scratch = [pltpu.VMEM((2, block_size, F), kv_cache.dtype)]
+    if quantized:
+        scratch.append(pltpu.VMEM((2, block_size, SW), jnp.float32))
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2 if quantized else 1)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(S, Q // Qt),
-        in_specs=[
-            pl.BlockSpec((1, Qt * H, F), lambda s, t, *_: (s, t, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Qt * H, 1), lambda s, t, *_: (s, t, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, Qt * H, F), lambda s, t, *_: (s, t, 0),
                          memory_space=pltpu.VMEM),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((2, block_size, F), kv_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
-        _mla_prefill_kernel, block_size=block_size, scale=scale)
+        _mla_prefill_kernel, block_size=block_size, scale=scale,
+        quantized=quantized)
+    operands = [block_tables, seq_lens, layer_arr, q_fused, qpos_fused,
+                kv_cache]
+    if quantized:
+        operands.append(kv_scale)
     (out,) = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -197,5 +236,5 @@ def mla_flash_prefill(
         compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(block_tables, seq_lens, layer_arr, q_fused, qpos_fused, kv_cache)
+    )(*operands)
     return out.reshape(S, Q, H, F)

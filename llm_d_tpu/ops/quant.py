@@ -65,9 +65,14 @@ def dequantize(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 # Engine-facing knob values (engine/engine.py resolves LLMD_KV_CACHE_DTYPE /
-# LLMD_KV_SCALE_GRAN through these).
+# LLMD_KV_SCALE_GRAN / LLMD_MLA_LATENT_DTYPE through these).
 KV_CACHE_DTYPES = ("bf16", "int8")
 KV_SCALE_GRANULARITIES = ("token", "head")
+# MLA latent-row gate: "auto" follows kv_cache_dtype; "bf16"/"int8" pin
+# the latent dtype independently of the dense knob (the latent feeds TWO
+# weight absorptions, so its quantization is gated by its own accuracy
+# harness — ops/mla_accuracy.py, asserted in tests/test_mla_quant.py).
+MLA_LATENT_DTYPES = ("auto", "bf16", "int8")
 
 
 def kv_scale_width(num_kv_heads: int, granularity: str) -> int:

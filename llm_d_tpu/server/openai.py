@@ -890,7 +890,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="paged-KV cache dtype: int8 stores per-page-row-scaled "
              "payloads + f32 scale planes — halves decode HBM/DMA bytes, "
              "~doubles the block pool at a fixed budget, halves P->D and "
-             "offload payloads (dense K/V models; MLA stays bf16). "
+             "offload payloads (dense K/V AND the MLA latent row; "
+             "LLMD_MLA_LATENT_DTYPE gates the latent separately). "
              "Default: LLMD_KV_CACHE_DTYPE (bf16)")
     p.add_argument(
         "--kv-cache-hbm-gb", type=float, default=None,

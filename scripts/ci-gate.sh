@@ -20,5 +20,10 @@ python -m pytest tests/test_lifecycle.py -q
 # parity bounds, offload scale round-trip, wire dtype rejection, pool
 # sizing): a silent KV-numerics or wire-format break must not merge.
 python -m pytest tests/test_kv_quant.py -q
+# int8 MLA LATENT contract fail-fast (round 9: quantized MLA kernels,
+# per-absorption accuracy bounds on real traces, latent wire/offload
+# round-trips): the flagship MoE bench serves on this cache.
+python -m pytest tests/test_mla_quant.py -q
 python -m pytest tests/ --ignore=tests/test_chaos.py \
-    --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py
+    --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py \
+    --ignore=tests/test_mla_quant.py

@@ -225,6 +225,107 @@ def run_paged(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# MLA decode sweep (context-length x latent dtype): the MLA decode kernel's
+# bf16-vs-int8 LATENT crossover table, mirroring --paged for the single
+# latent buffer.  The latent stream is the only per-step byte term that
+# grows with batch and context on the MoE bench model, so this table is
+# where the LLMD_MLA_* knobs (and the kv_cache_dtype=int8 default for MLA)
+# get re-derived on a real chip; --interpret runs the same glue on CPU for
+# tier-1 (timings flagged invalid).
+# ---------------------------------------------------------------------------
+
+def _mla_case(key, S, H, F, bs, ctx, num_layers=2, plane=1):
+    """Engine-shaped MLA decode case over a stacked latent cache."""
+    import numpy as np
+    rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 1 << 30)))
+    B = -(-ctx // bs)
+    num_blocks = S * B + 1
+    kv = jnp.asarray(
+        rng.standard_normal((num_layers, num_blocks * bs, F)), jnp.bfloat16)
+    perm = rng.permutation(num_blocks - 1)[: S * B] + 1
+    bt = jnp.asarray(perm.reshape(S, B), jnp.int32)
+    lens = jnp.asarray(
+        np.clip(ctx - rng.integers(0, bs, S), 1, ctx), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((S, H, F)), jnp.bfloat16)
+    row = jnp.asarray(rng.standard_normal((S, F)), jnp.bfloat16)
+    return q, row, kv, bt, lens, jnp.asarray(plane, jnp.int32)
+
+
+def _mla_thunks(case, bs, interpret):
+    """dtype -> thunk running the REAL MLA decode kernel at that latent
+    dtype (int8: pre-quantized rows + the sibling scale plane)."""
+    from llm_d_tpu.ops.pallas.mla_attention import mla_paged_decode_update
+    from llm_d_tpu.ops.quant import quantize_kv_block
+    q, row, kv, bt, lens, plane = case
+    scale = q.shape[-1] ** -0.5
+
+    def bf16():
+        return mla_paged_decode_update(
+            q, row, kv, bt, lens, block_size=bs, scale=scale, layer=plane,
+            interpret=interpret)[0]
+
+    kq, ks = quantize_kv_block(kv, 1)
+    rq, rs = quantize_kv_block(row, 1)
+
+    def int8():
+        return mla_paged_decode_update(
+            q, rq, kq, bt, lens, block_size=bs, scale=scale, layer=plane,
+            interpret=interpret, kv_scale=ks, row_scale_new=rs)[0]
+
+    return {"bf16": bf16, "int8": int8}
+
+
+def run_mla(args) -> dict:
+    if args.interpret:
+        S, H, F, bs = 4, 4, 128, 32
+        sweep = [64, 128]
+        iters = args.iters or 1
+    else:
+        # deepseek-v3-bench decode shapes at the gated bs256 point:
+        # H=16 heads, F = 512 + 64 lane-padded to 640.
+        S, H, F, bs = 256, 16, 640, 64
+        sweep = [256, 512, 1024, 2048, 4096]
+        iters = args.iters or 10
+    if args.ctx_sweep:
+        sweep = [int(t) for t in args.ctx_sweep.split(",") if t]
+    points = []
+    from llm_d_tpu.engine.engine import kv_bytes_per_token
+    layout = {"kv": F}
+    for i, ctx in enumerate(sweep):
+        case = _mla_case(jax.random.PRNGKey(i), S, H, F, bs, ctx)
+        thunks = _mla_thunks(case, bs, args.interpret)
+        ms = {name: round(_time_ms(t, iters), 3)
+              for name, t in thunks.items()}
+        points.append({
+            "ctx": ctx, "ms": ms,
+            # Per-step latent bytes each dtype streams at this context
+            # (pages + the int8 scale plane; same accounting the engine's
+            # pool sizing and bench's roofline charge).
+            "kv_mb_per_step": {
+                dtype: round(
+                    S * ctx * kv_bytes_per_token(layout, dtype, 1) / 1e6, 3)
+                for dtype in ("bf16", "int8")
+            }})
+    crossover = None
+    for p in points:
+        if p["ms"]["int8"] <= p["ms"]["bf16"]:
+            crossover = p["ctx"]
+            break
+    return {
+        "mode": "mla_decode",
+        "backend": jax.default_backend(),
+        "interpret": args.interpret,
+        "timings_valid": not args.interpret,
+        "shapes": {"S": S, "H": H, "F": F, "block_size": bs},
+        "iters": iters,
+        "points": points,
+        "crossover": {"int8_faster_from_ctx": crossover,
+                      "LLMD_MLA_LATENT_DTYPE":
+                          "int8" if crossover is not None else "bf16"},
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interpret", action="store_true",
@@ -235,8 +336,12 @@ def main(argv=None) -> int:
                     help="run the paged-attention context x dtype sweep "
                          "(bf16 vs int8 KV cache) instead of the MoE "
                          "kernel family")
+    ap.add_argument("--mla", action="store_true",
+                    help="run the MLA decode context x latent-dtype sweep "
+                         "(bf16 vs int8 latent cache) instead of the MoE "
+                         "kernel family")
     ap.add_argument("--ctx-sweep", type=str, default=None,
-                    help="paged mode: comma-separated context lengths "
+                    help="paged/mla mode: comma-separated context lengths "
                          "(default: 256..4096 on chip, 64,128 interpreted)")
     ap.add_argument("--t-sweep", type=str, default=None,
                     help="comma-separated token counts (default: "
@@ -254,8 +359,8 @@ def main(argv=None) -> int:
                     help="also write the JSON document to this path")
     args = ap.parse_args(argv)
 
-    if args.paged:
-        doc = run_paged(args)
+    if args.paged or args.mla:
+        doc = run_paged(args) if args.paged else run_mla(args)
         text = json.dumps(doc)
         print(text)
         if args.out:

@@ -568,6 +568,40 @@ def test_kv_pull_drops_with_int8_kv_cache(inject):
         producer.kv_connector.close()
 
 
+def test_kv_pull_drops_with_int8_latent_mla(inject):
+    """Round 9: the int8 LATENT wire (MLA, kv + kv_scale buffer pair) is
+    resilience-clean too — injected pull drops recover through the retry
+    budget / recompute fallback exactly as over the dense int8 wire, and
+    every request decodes to parity with an int8-latent baseline."""
+    kw = dict(ENGINE_KW, model="tiny-mla", kv_cache_dtype="int8")
+    baseline = EngineCore(EngineConfig(**kw))
+    producer = EngineCore(EngineConfig(**kw), params=baseline.params)
+    producer.kv_connector = TpuConnector(
+        KVConnectorConfig(kv_role="kv_producer", host="127.0.0.1"))
+    inj = inject()
+    inj.add_rule("kv.pull", probability=0.3)
+    consumer = EngineCore(EngineConfig(**kw), params=baseline.params)
+    consumer.kv_connector = TpuConnector(KVConnectorConfig(
+        kv_role="kv_consumer", kv_load_failure_policy="recompute",
+        timeout_ms=2000, pull_retries=2, pull_backoff_s=0.01))
+    try:
+        prompts = {f"mlaq8-{i}": [5 + i, 1, 4, 1, 5, 9, 2 + i]
+                   for i in range(6)}
+        expected = {rid: baseline.generate(
+            [greedy_req("b" + rid, p, 4)])["b" + rid]
+            for rid, p in prompts.items()}
+        for rid, prompt in prompts.items():
+            params = _remote_prefill(producer, rid, prompt)
+            dreq = greedy_req(rid, prompt, 4, do_remote_prefill=True,
+                              kv_transfer_params=params)
+            out = consumer.generate([dreq])
+            assert out[rid] == expected[rid], rid
+        assert inj.stats()["kv.pull"]["fired"] >= 1
+    finally:
+        consumer.kv_connector.close()
+        producer.kv_connector.close()
+
+
 def test_kv_pull_total_outage_terminates_under_policy_fail(
         pd_engines, inject):
     """100% pull drops + policy=fail: the request ABORTS loudly (bounded

@@ -70,6 +70,31 @@ def test_kernel_bench_paged_sweep_interpret(tmp_path, capsys):
     assert "LLMD_KV_CACHE_DTYPE" in doc["crossover"]
 
 
+def test_kernel_bench_mla_sweep_interpret(tmp_path, capsys):
+    """--mla: the context x latent-dtype MLA decode sweep runs both cache
+    dtypes through the REAL mla_paged_decode_update glue (bf16 and int8
+    latent + scale plane) on the interpreter and derives the crossover
+    block."""
+    mod = _kernel_bench()
+    out = tmp_path / "mla.json"
+    rc = mod.main(["--mla", "--interpret", "--ctx-sweep", "48,96",
+                   "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc == json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["mode"] == "mla_decode"
+    assert doc["timings_valid"] is False
+    assert [p["ctx"] for p in doc["points"]] == [48, 96]
+    for p in doc["points"]:
+        for dtype in ("bf16", "int8"):
+            assert isinstance(p["ms"][dtype], float) and p["ms"][dtype] > 0
+        # The byte accounting the crossover explains: the int8 latent
+        # streams about half the page bytes (+ the f32 scale plane).
+        assert p["kv_mb_per_step"]["int8"] < 0.6 * p["kv_mb_per_step"]["bf16"]
+    assert "int8_faster_from_ctx" in doc["crossover"]
+    assert "LLMD_MLA_LATENT_DTYPE" in doc["crossover"]
+
+
 def test_kernel_bench_respects_path_caps(tmp_path):
     """--dense-max-t / --routed-max-t null out the capped paths (the
     shapes a real chip cannot run) and the recommendation still derives
@@ -137,7 +162,9 @@ def test_regression_gate_three_metrics_band_verdict():
     dense = {64: {"decode_tok_s": 11000.0,
                   "decode_tok_s_band": [10800.0, 11500.0]}}
     moe = {256: {"decode_tok_s": 16000.0,
-                 "decode_tok_s_band": [15500.0, 15900.0]},
+                 "decode_tok_s_band": [15500.0, 15900.0],
+                 "decode_hbm_roofline_pct": 40.0,
+                 "decode_hbm_roofline_pct_band": [38.0, 41.5]},
            64: {"prefill_tok_s": 20000.0, "prefill_mfu_pct": 21.0,
                 "prefill_tok_s_band": [19000.0, 21000.0]}}
     gate = bench._regression_gate(dense, moe)
@@ -149,10 +176,25 @@ def test_regression_gate_three_metrics_band_verdict():
     assert gate["moe_prefill_tok_s_bs64_regressed"] is False
     assert gate["moe_prefill_tok_s_bs64_delta_pct"] > 0
     assert gate["moe_prefill_tok_s_bs64_mfu_pct"] == 21.0
-    # No band (single sample) -> no verdict.
+    # Roofline YIELD at bs256 is first-class: band clears the 36.9 best
+    # (not regressed) but the 55% target is not met yet.
+    assert gate["moe_decode_roofline_bs256_regressed"] is False
+    assert gate["moe_decode_roofline_bs256_target_pct"] == 55.0
+    assert gate["moe_decode_roofline_bs256_meets_target"] is False
+    # A yield collapse regresses even when raw tok/s would pass.
+    gate_low = bench._regression_gate(dense, {
+        256: {"decode_tok_s": 17000.0,
+              "decode_tok_s_band": [16500.0, 17500.0],
+              "decode_hbm_roofline_pct": 30.0,
+              "decode_hbm_roofline_pct_band": [28.0, 32.0]}})
+    assert gate_low["moe_bs256_regressed"] is False
+    assert gate_low["moe_decode_roofline_bs256_regressed"] is True
+    # No band (single sample) -> no verdict; missing roofline key (old
+    # sweeps) -> metric skipped, not a crash.
     gate2 = bench._regression_gate(
         {64: {"decode_tok_s": 11000.0}},
         {256: {"decode_tok_s": 16000.0},
          64: {"prefill_tok_s": 20000.0, "prefill_mfu_pct": 21.0}})
     assert gate2["dense_bs64_regressed"] is None
     assert gate2["moe_prefill_tok_s_bs64_regressed"] is None
+    assert gate2["moe_decode_roofline_bs256_delta_pct"] is None

@@ -328,9 +328,14 @@ def test_engine_e2e_int8_generates_deterministically():
     assert len(a) == 6 and a == b, (a, b)
 
 
-def test_engine_int8_rejects_mla():
-    with pytest.raises(ValueError, match="MLA"):
-        EngineCore(EngineConfig(model="tiny-mla", kv_cache_dtype="int8"))
+def test_engine_int8_mla_builds_latent_cache():
+    """The PR-5 rejection is lifted (round 9): int8 + MLA builds the int8
+    latent buffer with its per-row scale plane (full contract coverage
+    lives in tests/test_mla_quant.py)."""
+    e = EngineCore(EngineConfig(model="tiny-mla", kv_cache_dtype="int8"))
+    assert e.kv_cache["kv"].dtype == jnp.int8
+    assert e.kv_cache["kv_scale"].dtype == jnp.float32
+    assert e.kv_scale_width == 1           # one symmetric scale per row
 
 
 def test_engine_rejects_unknown_dtype_and_granularity():

@@ -1,0 +1,407 @@
+"""Int8 MLA latent cache contract (kv_cache_dtype=int8 + MLA), end to end.
+
+The claim under test is the ISSUE-6 acceptance set: the quantized MLA
+decode/prefill kernels match the bf16 latent within an explicit bound
+(kernel AND XLA fallback are the same dequantize-then-attend numerics),
+the per-absorption accuracy harness holds its documented bounds on REAL
+decode traces (the latent feeds TWO weight absorptions — score via W_uk,
+value via W_uv — so each is bounded separately), the latent block pool is
+>= 1.9x bf16 at a fixed HBM budget, the offload tier round-trips the
+latent + scale plane byte-exactly, and the P->D wire REJECTS a latent
+dtype mismatch instead of reinterpreting it.  Everything runs on CPU:
+Pallas via ``interpret=True``, engine paths via the XLA fallback.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore, derive_num_blocks
+from llm_d_tpu.engine.request import Request
+from llm_d_tpu.models.config import get_config
+from llm_d_tpu.ops import attention as A
+from llm_d_tpu.ops import mla_accuracy as acc
+from llm_d_tpu.ops.pallas.mla_attention import mla_paged_decode_update
+from llm_d_tpu.ops.pallas.mla_prefill import mla_flash_prefill
+from llm_d_tpu.ops.quant import dequantize_kv_block, quantize_kv_block
+from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.transfer.connector import _pack_blocks, _scatter_blocks
+
+# Same quantization-error contract as the dense int8 cache: one symmetric
+# scale per 576-wide latent row, per-element error <= amax/254; the
+# softmax-weighted row sums land well inside this band.
+ATOL_VS_BF16 = 8e-2
+
+
+def greedy_req(rid, prompt, n=4, **kw):
+    return Request(request_id=rid, prompt_token_ids=list(prompt),
+                   sampling=SamplingParams(temperature=0.0, max_tokens=n,
+                                           ignore_eos=True), **kw)
+
+
+ENGINE_KW = dict(model="tiny-mla", block_size=4, num_blocks=64,
+                 max_num_seqs=4, max_num_batched_tokens=64,
+                 min_token_bucket=16, min_seq_bucket=4)
+
+PROMPT = [7, 3, 9, 1, 4, 6, 2, 8, 5, 0, 11, 13]
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel parity (interpret mode)
+# ---------------------------------------------------------------------------
+
+def _decode_case(seed, S, H, F, bs, num_blocks, seq_lens, L=3):
+    rng = np.random.default_rng(seed)
+    kv = jnp.asarray(rng.standard_normal((L, num_blocks * bs, F)),
+                     jnp.bfloat16)
+    B = max(-(-int(max(seq_lens)) // bs), 1)
+    perm = rng.permutation(num_blocks - 1)[: S * B] + 1
+    bt = jnp.asarray(perm.reshape(S, B), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((S, H, F)), jnp.bfloat16)
+    row = jnp.asarray(rng.standard_normal((S, F)), jnp.bfloat16)
+    return q, row, kv, bt, jnp.asarray(seq_lens, jnp.int32)
+
+
+def _bf16_decode_oracle(q, row, kv, bt, lens, bs, scale, layer):
+    S, H, F = q.shape
+    slot = (jnp.take_along_axis(bt, ((lens - 1) // bs)[:, None],
+                                axis=1)[:, 0] * bs + (lens - 1) % bs)
+    kv, _ = A.write_kv(kv, kv, row.reshape(S, 1, F), row.reshape(S, 1, F),
+                       slot, layer=layer)
+    out = A.ragged_paged_attention_reference(
+        q, kv, kv, jnp.arange(S, dtype=jnp.int32), lens - 1, bt, lens,
+        block_size=bs, scale=scale, layer=layer)
+    return out, slot
+
+
+def test_mla_decode_kernel_int8_parity():
+    """The quantized MLA kernel must (a) EXACTLY match the dequantize-
+    then-attend oracle built from the same int8 latent — kernel and XLA
+    fallback implement identical numerics — and (b) match the pure-bf16
+    latent within the quoted quantization bound; the new row's int8 bytes
+    and f32 scale splice back byte-exactly."""
+    H, F, bs, L = 4, 128, 32, 3
+    seq_lens = [1, bs // 2, bs, bs + 3, 3 * bs]
+    S = len(seq_lens)
+    scale = 0.17
+    q, row, kv_bf, bt, lens = _decode_case(
+        7, S, H, F, bs, num_blocks=S * 3 + 1, seq_lens=seq_lens, L=L)
+    layer = jnp.asarray(1, jnp.int32)
+
+    kq, ks = quantize_kv_block(kv_bf, 1)
+    rq, rs = quantize_kv_block(row, 1)
+    out, kv_u, ks_u = mla_paged_decode_update(
+        q, rq, kq, bt, lens, block_size=bs, scale=scale, layer=layer,
+        interpret=True, kv_scale=ks, row_scale_new=rs)
+
+    # (a) vs the dequantized-int8 oracle: bf16-rounding-level agreement.
+    ref_q, slot = _bf16_decode_oracle(
+        q, dequantize_kv_block(rq, rs), dequantize_kv_block(kq, ks),
+        bt, lens, bs, scale, layer)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_q, np.float32),
+        atol=2e-2, rtol=2e-2)
+    # (b) vs pure bf16: the quantization bound the docs quote.
+    ref_bf, _ = _bf16_decode_oracle(q, row, kv_bf, bt, lens, bs, scale,
+                                    layer)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_bf, np.float32),
+        atol=ATOL_VS_BF16, rtol=ATOL_VS_BF16)
+
+    # Write-back byte-exact: payload AND scale land where the scatter
+    # oracle puts them; untouched layer planes stay untouched.
+    np.testing.assert_array_equal(
+        np.asarray(kv_u), np.asarray(kq.at[layer, slot].set(rq)))
+    np.testing.assert_array_equal(
+        np.asarray(ks_u), np.asarray(ks.at[layer, slot].set(rs)))
+    np.testing.assert_array_equal(np.asarray(kv_u[0]), np.asarray(kq[0]))
+    np.testing.assert_array_equal(np.asarray(ks_u[2]), np.asarray(ks[2]))
+
+
+@pytest.mark.parametrize("seq_group", [1, 4])
+def test_mla_decode_kernel_int8_grouping_and_pad_rows(seq_group):
+    """Grouped programs over the int8 latent with ragged lengths and
+    zero-length pad rows (clamped dead reads, no write-back) still match
+    the oracle."""
+    H, F, bs = 4, 128, 32
+    real_lens = [1, 7, bs, 2 * bs + 5]
+    S = 8
+    seq_lens = real_lens + [0] * (S - len(real_lens))
+    q, row, kv_bf, bt, lens = _decode_case(
+        21 + seq_group, S, H, F, bs, num_blocks=S * 3 + 1,
+        seq_lens=seq_lens, L=1)
+    bt = bt.at[len(real_lens):].set(0)
+    kq, ks = quantize_kv_block(kv_bf, 1)
+    rq, rs = quantize_kv_block(row, 1)
+    out, _, _ = mla_paged_decode_update(
+        q, rq, kq, bt, lens, block_size=bs, scale=0.21,
+        layer=jnp.asarray(0, jnp.int32), interpret=True,
+        seq_group=seq_group, kv_scale=ks, row_scale_new=rs)
+    n = len(real_lens)
+    ref, _ = _bf16_decode_oracle(
+        q[:n], dequantize_kv_block(rq, rs)[:n],
+        dequantize_kv_block(kq, ks), bt[:n], lens[:n], bs, 0.21,
+        jnp.asarray(0, jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(out[:n], np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# Pallas prefill kernel parity (interpret mode)
+# ---------------------------------------------------------------------------
+
+def test_mla_prefill_kernel_int8_parity():
+    rng = np.random.default_rng(11)
+    S, Q, H, F, bs, L = 3, 8, 4, 128, 32, 2
+    num_blocks, B = 12, 3
+    seq_lens = np.array([5, 40, 96], np.int32)
+    kv_bf = jnp.asarray(rng.standard_normal((L, num_blocks * bs, F)),
+                        jnp.bfloat16)
+    perm = rng.permutation(num_blocks - 1)[: S * B] + 1
+    bt = jnp.asarray(perm.reshape(S, B), jnp.int32)
+    lens = jnp.asarray(seq_lens)
+    layer = jnp.asarray(1, jnp.int32)
+    qs = jnp.asarray(rng.standard_normal((S, Q, H, F)), jnp.bfloat16)
+    q_pos = jnp.asarray(np.stack(
+        [np.clip(np.arange(Q) + l - Q, -1, None) for l in seq_lens]),
+        jnp.int32)
+
+    kq, ks = quantize_kv_block(kv_bf, 1)
+    out = mla_flash_prefill(
+        qs, q_pos, kq, bt, lens, block_size=bs, scale=0.2, layer=layer,
+        interpret=True, kv_scale=ks)
+    # Same-numerics oracle: the bf16 kernel over the dequantized latent.
+    ref_q = mla_flash_prefill(
+        qs, q_pos, dequantize_kv_block(kq, ks), bt, lens, block_size=bs,
+        scale=0.2, layer=layer, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_q, np.float32),
+        atol=2e-2, rtol=2e-2)
+    ref_bf = mla_flash_prefill(
+        qs, q_pos, kv_bf, bt, lens, block_size=bs, scale=0.2, layer=layer,
+        interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_bf, np.float32),
+        atol=ATOL_VS_BF16, rtol=ATOL_VS_BF16)
+
+
+# ---------------------------------------------------------------------------
+# Per-absorption accuracy harness on REAL decode traces
+# ---------------------------------------------------------------------------
+
+def test_absorption_harness_bounds_on_real_trace():
+    """Harvest latent rows a bf16 tiny-MLA engine actually wrote, score
+    them with the model's own absorbed queries, and assert the documented
+    per-absorption bounds — the gate that justified lifting the int8+MLA
+    rejection."""
+    e = EngineCore(EngineConfig(**ENGINE_KW))
+    reqs = [greedy_req(f"t{i}", [(7 * i + 13 * j) % 500 + 1
+                                 for j in range(12)], 6) for i in range(4)]
+    e.generate(reqs)
+    rows = acc.harvest_latent_rows(e)
+    assert rows.shape[0] >= 16, rows.shape   # traffic actually traced
+
+    c = get_config("tiny-mla")
+    lp = {k: v[0] for k, v in e.params["moe_layers"].items()}
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (8, c.hidden_size)), jnp.bfloat16)
+    q_eff, w_uv = acc.absorbed_queries(
+        lp, c, x, jnp.arange(8, dtype=jnp.int32))
+    rep = acc.absorption_error_report(
+        rows, q_eff, w_uv, c.kv_lora_rank,
+        scale=(c.qk_nope_head_dim + c.qk_rope_head_dim) ** -0.5)
+    # Both absorptions bounded SEPARATELY (score error enters pre-softmax,
+    # value error post-softmax — different amplification paths).
+    assert rep["score"]["rel_rms"] <= rep["score"]["bound_rel_rms"], rep
+    assert rep["value"]["rel_rms"] <= rep["value"]["bound_rel_rms"], rep
+    assert rep["within_bounds"] is True
+    assert rep["end_to_end"]["rel_rms"] <= 2 * acc.VALUE_REL_BOUND
+
+
+# ---------------------------------------------------------------------------
+# Block pool + engine e2e
+# ---------------------------------------------------------------------------
+
+def test_mla_block_pool_at_least_1p9x_at_same_budget():
+    layout = {"kv": 640}                   # deepseek-v3 lane-padded latent
+    budget = 4 << 30
+    bf16 = derive_num_blocks(budget, layout, 61, 64, "bf16")
+    int8 = derive_num_blocks(budget, layout, 61, 64, "int8", 1)
+    assert int8 / bf16 >= 1.9, (bf16, int8)
+
+
+def test_engine_mla_int8_builds_and_generates_deterministically():
+    bf = EngineCore(EngineConfig(**ENGINE_KW))
+    q8a = EngineCore(EngineConfig(**ENGINE_KW, kv_cache_dtype="int8"),
+                     params=bf.params)
+    q8b = EngineCore(EngineConfig(**ENGINE_KW, kv_cache_dtype="int8"),
+                     params=bf.params)
+    assert q8a.kv_cache["kv"].dtype == jnp.int8
+    assert q8a.kv_cache["kv_scale"].dtype == jnp.float32
+    assert q8a.kv_cache["kv_scale"].shape[-1] == 1   # one scale per row
+    a = q8a.generate([greedy_req("a", PROMPT, 6)])["a"]
+    b = q8b.generate([greedy_req("b", PROMPT, 6)])["b"]
+    assert len(a) == 6 and a == b, (a, b)
+
+
+def test_mla_latent_dtype_gate(monkeypatch):
+    """LLMD_MLA_LATENT_DTYPE gates the latent independently: 'bf16' pins
+    it under kv_cache_dtype=int8 (the accuracy escape hatch), 'int8'
+    forces it under the bf16 default, invalid values degrade to auto."""
+    monkeypatch.setenv("LLMD_MLA_LATENT_DTYPE", "bf16")
+    e = EngineCore(EngineConfig(**ENGINE_KW, kv_cache_dtype="int8"))
+    assert e.kv_cache_dtype == "bf16" and "kv_scale" not in e.kv_cache
+    monkeypatch.setenv("LLMD_MLA_LATENT_DTYPE", "int8")
+    e = EngineCore(EngineConfig(**ENGINE_KW))
+    assert e.kv_cache_dtype == "int8" and "kv_scale" in e.kv_cache
+    monkeypatch.setenv("LLMD_MLA_LATENT_DTYPE", "fp4")
+    e = EngineCore(EngineConfig(**ENGINE_KW, kv_cache_dtype="int8"))
+    assert e.kv_cache_dtype == "int8"      # invalid env -> auto (follow)
+
+
+def test_mla_seq_group_env_non_divisor_degrades_to_auto(monkeypatch):
+    """Env-knob contract: LLMD_MLA_SEQ_GROUP that does not divide the
+    current sequence bucket falls back to auto grouping instead of
+    crashing the decode path (S varies with load, the knob must not)."""
+    import llm_d_tpu.models.mla as mla_mod
+    import llm_d_tpu.ops.pallas.mla_attention as ma
+
+    monkeypatch.setenv("LLMD_MLA_SEQ_GROUP", "7")    # divides no pow2 S
+    monkeypatch.setattr(A, "resolve_backend", lambda b: "pallas")
+    real = ma.mla_paged_decode_update
+    seen = {}
+
+    def spy(*a, **kw):
+        seen["seq_group"] = kw.get("seq_group")
+        kw["interpret"] = True
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ma, "mla_paged_decode_update", spy)
+    c = get_config("tiny-mla")
+    lp = {k: v[:1] for k, v in EngineCore(
+        EngineConfig(**ENGINE_KW)).params["moe_layers"].items()}
+    lp = {k: v[0] for k, v in lp.items()}
+    S, bs = 2, 16
+    F = -(-(c.kv_lora_rank + c.qk_rope_head_dim) // 128) * 128
+    kv = jnp.zeros((1, 8 * bs, F), jnp.bfloat16)
+    lens = jnp.asarray([3, 5], jnp.int32)
+    batch = dict(
+        token_ids=jnp.zeros(S, jnp.int32),
+        positions=lens - 1,
+        token_seq_ids=jnp.arange(S, dtype=jnp.int32),
+        token_qpos=jnp.zeros(S, jnp.int32),
+        slot_mapping=jnp.asarray([1 * bs + 2, 2 * bs + 4], jnp.int32),
+        block_tables=jnp.asarray([[1], [2]], jnp.int32),
+        seq_lens=lens,
+        qtok_idx=jnp.arange(S, dtype=jnp.int32)[:, None],
+    )
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (S, c.hidden_size)), jnp.bfloat16)
+    out, _ = mla_mod.mla_attention_block(
+        lp, c, x, batch, kv, bs, "pallas", layer=jnp.int32(0))
+    assert out.shape == (S, c.hidden_size)
+    assert seen["seq_group"] is None       # non-divisor degraded to auto
+
+
+# ---------------------------------------------------------------------------
+# Offload tier: latent + scale plane round-trip
+# ---------------------------------------------------------------------------
+
+def test_offload_mla_int8_byte_exact_and_restore_parity():
+    engine = EngineCore(EngineConfig(
+        model="tiny-mla", block_size=4, num_blocks=16, max_num_seqs=4,
+        max_num_batched_tokens=64, min_token_bucket=16, min_seq_bucket=4,
+        kv_offload_blocks=64, kv_cache_dtype="int8"))
+    first = engine.generate([greedy_req("a1", PROMPT, 4)])["a1"]
+    assert engine.host_tier.saves >= 3
+    from llm_d_tpu.engine.offload import (
+        _pack_block_slab, _slab_layout, _unpack_block_slab)
+    blob = next(iter(engine.host_tier._store.values()))
+    L = engine.model_config.num_layers
+    slab = _unpack_block_slab(blob, _slab_layout(engine), L, 4)
+    assert slab["kv"].dtype == np.int8
+    assert slab["kv_scale"].dtype == np.float32
+    assert _pack_block_slab(slab) == blob      # byte-exact round trip
+
+    for i in range(6):
+        filler = [(100 + 17 * i + j) % 500 for j in range(12)]
+        engine.generate([greedy_req(f"f{i}", filler, 2)])
+    assert engine.kv_manager.eviction_count > 0
+    r2 = greedy_req("a2", PROMPT, 4)
+    second = engine.generate([r2])["a2"]
+    assert second == first
+    assert engine.host_tier.loads > 0
+    assert r2.num_cached_prompt_tokens >= 8
+
+
+# ---------------------------------------------------------------------------
+# P->D wire: latent dtype rejection + int8-to-int8 parity
+# ---------------------------------------------------------------------------
+
+def test_transfer_wire_mla_latent_dtype_rejection():
+    bf = EngineCore(EngineConfig(**ENGINE_KW))
+    q8 = EngineCore(EngineConfig(**ENGINE_KW, kv_cache_dtype="int8"),
+                    params=bf.params)
+    q8b = EngineCore(EngineConfig(**ENGINE_KW, kv_cache_dtype="int8"),
+                     params=bf.params)
+    q8.generate([greedy_req("a", PROMPT[:8], 2)])
+    bf.generate([greedy_req("a", PROMPT[:8], 2)])
+    blocks = [1, 2]
+    blob8 = _pack_blocks(q8, blocks)
+    blob16 = _pack_blocks(bf, blocks)
+    # ~Half the bytes (+ scale plane and headers; the tiny model's narrow
+    # 128-wide padded row keeps overhead visible).
+    assert len(blob8) < 0.65 * len(blob16), (len(blob8), len(blob16))
+
+    # int8 -> int8: latent payload AND scales land byte-exactly.
+    _scatter_blocks(q8b, blocks, blob8)
+    slots = slice(blocks[0] * 4, (blocks[-1] + 1) * 4)
+    for name in q8.kv_cache:
+        np.testing.assert_array_equal(
+            np.asarray(q8.kv_cache[name][:, slots]),
+            np.asarray(q8b.kv_cache[name][:, slots]), err_msg=name)
+
+    # int8-latent producer -> bf16-latent consumer: REJECTED (the buffer
+    # set differs — kv vs kv+kv_scale), never reinterpreted; and the
+    # reverse direction too.
+    with pytest.raises(ValueError):
+        _scatter_blocks(bf, blocks, blob8)
+    with pytest.raises(ValueError):
+        _scatter_blocks(q8b, blocks, blob16)
+
+
+def test_pd_e2e_mla_int8_parity():
+    """Producer -> consumer over the real connector with int8 latent
+    caches on both sides: the pulled prefix decodes exactly like a local
+    int8 run."""
+    from llm_d_tpu.transfer.connector import KVConnectorConfig, TpuConnector
+    from llm_d_tpu.engine.request import RequestState
+    import time
+    kw = dict(ENGINE_KW, kv_cache_dtype="int8")
+    baseline = EngineCore(EngineConfig(**kw))
+    producer = EngineCore(EngineConfig(**kw), params=baseline.params)
+    producer.kv_connector = TpuConnector(
+        KVConnectorConfig(kv_role="kv_producer", host="127.0.0.1"))
+    consumer = EngineCore(EngineConfig(**kw), params=baseline.params)
+    consumer.kv_connector = TpuConnector(
+        KVConnectorConfig(kv_role="kv_consumer", timeout_ms=5000))
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        want = baseline.generate([greedy_req("b", prompt, 4)])["b"]
+        preq = greedy_req("pd", prompt, 1, do_remote_decode=True)
+        producer.add_request(preq)
+        for _ in range(500):
+            producer.step()
+            if preq.state == RequestState.FINISHED_REMOTE_PREFILL:
+                break
+            time.sleep(0.001)
+        assert preq.state == RequestState.FINISHED_REMOTE_PREFILL
+        dreq = greedy_req("pd", prompt, 4, do_remote_prefill=True,
+                          kv_transfer_params=preq.kv_transfer_params)
+        got = consumer.generate([dreq])["pd"]
+        assert got == want, (got, want)
+    finally:
+        producer.kv_connector.close()
+        consumer.kv_connector.close()
