@@ -1,0 +1,43 @@
+"""llmd-check: contract-enforcing static analysis for the whole stack.
+
+llm-d's value is that one repo *pins and binds* every protocol in the
+stack — header contracts, metric names, wire formats — so components
+cannot drift (SURVEY: the repo "defines the protocols that bind them").
+This package is the enforcement half of that doctrine: an AST-based
+multi-pass analysis suite run fail-fast by ``scripts/ci-gate.sh`` via
+``scripts/llmd_check.py``.
+
+Passes (see docs/static-analysis.md for the rule table):
+
+  headers   HDR     ``x-llmd-*`` / ``x-prefiller-*`` wire-header literals
+                    must live in ``utils/lifecycle.py`` only.
+  metrics   MET     every ``llmd_tpu:*`` metric name is declared once in
+                    ``utils/metrics.py`` and cross-checked against the
+                    monitoring docs.
+  envvars   ENV     env-knob registry (call site <-> docs/ENVVARS.md row
+                    <-> default consistency), absorbing the old
+                    scripts/lint-envvars.py.
+  jit       JIT     host-sync hygiene inside jit-decorated and
+                    engine-step-reachable functions.
+  async     ASYNC   blocking primitives inside ``async def`` / async
+                    modules, locks held across ``await``.
+  pallas    PAL     Pallas kernel invariants: DMA start/wait pairing,
+                    int8 tiling divisibility gates, --interpret parity
+                    test coverage.
+  docker    DOCKER  scripts/lint-dockerfile.py, surfaced under the same
+                    CLI / baseline / suppression machinery.
+
+Per-line suppression: ``# llmd: ignore[RULE]`` (same line or the line
+above; ``RULE`` may be a full id like ``JIT003`` or a family prefix like
+``JIT``).  Known-and-accepted findings can also live in the checked-in
+baseline file ``.llmd-check-baseline.json`` — kept empty by policy.
+"""
+
+from llm_d_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Context,
+    Finding,
+    Pass,
+    run_passes,
+)
+from llm_d_tpu.analysis.passes import all_passes  # noqa: F401

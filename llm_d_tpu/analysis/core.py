@@ -1,0 +1,272 @@
+"""Framework core for llmd-check: files, findings, suppressions, baseline.
+
+Everything here is plain stdlib (ast / json / pathlib) so the checker
+imports in milliseconds and never depends on jax — the gate must run
+first and fast, before any test collection.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+import subprocess
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ``# llmd: ignore[JIT003]`` or ``# llmd: ignore[JIT, ASYNC]`` — applies
+# to its own line and the line below (comment-above style).
+_IGNORE_RE = re.compile(r"#\s*llmd:\s*ignore\[([A-Za-z0-9_,\s]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str       # e.g. "HDR001"
+    path: str       # repo-relative, posix
+    line: int       # 1-based; 0 = whole-file / cross-file contract
+    message: str
+
+    def fingerprint(self) -> str:
+        """Line-free identity so baseline entries survive unrelated edits."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{self.rule} {loc} {self.message}"
+
+
+class SourceFile:
+    """One parsed Python file: text, AST, suppressions, docstring spans."""
+
+    def __init__(self, root: pathlib.Path, rel: str) -> None:
+        self.rel = rel
+        self.path = root / rel
+        self.text = self.path.read_text()
+        self.lines = self.text.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self._parse_failed = False
+        self._ignores: Optional[Dict[int, Set[str]]] = None
+        self._docstring_lines: Optional[Set[int]] = None
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if self._tree is None and not self._parse_failed:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError:
+                # compileall in ci-gate owns syntax errors; passes skip.
+                self._parse_failed = True
+        return self._tree
+
+    @property
+    def ignores(self) -> Dict[int, Set[str]]:
+        if self._ignores is None:
+            self._ignores = {}
+            for i, line in enumerate(self.lines, start=1):
+                m = _IGNORE_RE.search(line)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                    # A trailing comment suppresses ITS line only; only a
+                    # whole-line comment extends to the statement below —
+                    # otherwise one annotated violation would silently
+                    # cover an unannotated one on the next line.
+                    spans = (i, i + 1) if line.lstrip().startswith("#") \
+                        else (i,)
+                    for ln in spans:
+                        self._ignores.setdefault(ln, set()).update(rules)
+        return self._ignores
+
+    @property
+    def docstring_lines(self) -> Set[int]:
+        """Lines covered by module/class/function docstrings — prose, not
+        contract surface (a header name QUOTED in a docstring is
+        documentation, not a wire literal)."""
+        if self._docstring_lines is None:
+            spans: Set[int] = set()
+            tree = self.tree
+            if tree is not None:
+                nodes = [tree] + [n for n in ast.walk(tree)
+                                  if isinstance(n, (ast.FunctionDef,
+                                                    ast.AsyncFunctionDef,
+                                                    ast.ClassDef))]
+                for node in nodes:
+                    body = getattr(node, "body", [])
+                    if body and isinstance(body[0], ast.Expr) \
+                            and isinstance(body[0].value, ast.Constant) \
+                            and isinstance(body[0].value.value, str):
+                        doc = body[0].value
+                        end = doc.end_lineno or doc.lineno
+                        spans.update(range(doc.lineno, end + 1))
+            self._docstring_lines = spans
+        return self._docstring_lines
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for token in self.ignores.get(line, ()):
+            if rule == token or rule.startswith(token):
+                return True
+        return False
+
+
+class Context:
+    """Repo view shared by every pass.
+
+    File sets are split by role: passes scan ``package_files`` +
+    ``script_files`` for violations; ``test_files`` are reference-only
+    (a test asserting a wire literal is the contract WORKING, so tests
+    are never flagged — they feed coverage rules like PAL003 instead).
+    """
+
+    def __init__(self, root: pathlib.Path,
+                 changed_only: bool = False) -> None:
+        self.root = pathlib.Path(root)
+        self._cache: Dict[str, SourceFile] = {}
+        self.package_files = self._collect("llm_d_tpu", "**/*.py")
+        self.script_files = sorted(
+            p.relative_to(self.root).as_posix()
+            for p in (self.root / "scripts").glob("*.py"))
+        self.test_files = sorted(
+            p.relative_to(self.root).as_posix()
+            for p in (self.root / "tests").glob("*.py"))
+        self.changed: Optional[Set[str]] = (
+            self._git_changed() if changed_only else None)
+
+    def _collect(self, sub: str, pattern: str) -> List[str]:
+        base = self.root / sub
+        return sorted(
+            p.relative_to(self.root).as_posix()
+            for p in base.glob(pattern)
+            if "__pycache__" not in p.parts)
+
+    def _git_changed(self) -> Optional[Set[str]]:
+        """Files changed vs HEAD (worktree + index + untracked), or None
+        when git is unavailable/fails — None means "no scoping", i.e. a
+        full run.  An empty SET would instead filter out every finding
+        and report a lying 'clean'."""
+        changed: Set[str] = set()
+        # --relative: diff paths must be relative to ctx.root (the cwd),
+        # not the git toplevel — in a vendored checkout a toplevel-
+        # relative prefix would match no finding path and lie 'clean'.
+        # (ls-files --others is already cwd-relative.)
+        for args in (["git", "diff", "--name-only", "--relative", "HEAD"],
+                     ["git", "ls-files", "--others", "--exclude-standard"]):
+            try:
+                out = subprocess.run(
+                    args, cwd=self.root, capture_output=True, text=True,
+                    timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                return None
+            if out.returncode != 0:
+                return None
+            changed.update(l.strip() for l in out.stdout.splitlines()
+                           if l.strip())
+        return changed
+
+    def source(self, rel: str) -> SourceFile:
+        if rel not in self._cache:
+            self._cache[rel] = SourceFile(self.root, rel)
+        return self._cache[rel]
+
+    def sources(self, rels: Iterable[str]) -> Iterable[SourceFile]:
+        for rel in rels:
+            yield self.source(rel)
+
+    def read_text(self, rel: str) -> Optional[str]:
+        path = self.root / rel
+        if not path.exists():
+            return None
+        return path.read_text()
+
+
+class Pass:
+    """One analysis pass.  Subclasses set ``name`` / ``rules`` and
+    implement ``run``; suppression/baseline filtering is the runner's."""
+
+    name: str = ""
+    # rule id -> one-line description (the docs/--list-rules table).
+    rules: Dict[str, str] = {}
+
+    def run(self, ctx: Context) -> List[Finding]:
+        raise NotImplementedError
+
+
+class Baseline:
+    """Checked-in accepted-findings file.
+
+    Policy is an EMPTY baseline (fix, don't baseline); the mechanism
+    exists so a future PR can land a pass before its sweep, with each
+    entry carrying a mandatory ``reason``.
+    """
+
+    def __init__(self, path: pathlib.Path) -> None:
+        self.path = path
+        self.entries: List[dict] = []
+        if path.exists():
+            data = json.loads(path.read_text())
+            self.entries = list(data.get("findings", []))
+
+    def fingerprints(self) -> Set[str]:
+        return {f"{e['rule']}|{e['path']}|{e['message']}"
+                for e in self.entries}
+
+    @staticmethod
+    def write(path: pathlib.Path, findings: Sequence[Finding],
+              existing: Sequence[dict] = ()) -> None:
+        """Snapshot NEW findings into the baseline, PRESERVING existing
+        entries (their hand-written reasons must survive a re-snapshot;
+        dropping a still-live entry would un-baseline its finding and
+        turn the next full run red)."""
+        kept = list(existing)
+        kept_fps = {f"{e['rule']}|{e['path']}|{e['message']}" for e in kept}
+        data = {
+            "_doc": ("llmd-check accepted-findings baseline.  Policy: keep "
+                     "empty — fix findings or suppress inline with a "
+                     "justified '# llmd: ignore[RULE]'.  Every entry MUST "
+                     "carry a reason; see docs/static-analysis.md."),
+            "findings": kept + [
+                {"rule": f.rule, "path": f.path, "message": f.message,
+                 "reason": "TODO: justify or fix"}
+                for f in findings if f.fingerprint() not in kept_fps],
+        }
+        path.write_text(json.dumps(data, indent=2) + "\n")
+
+
+def run_passes(ctx: Context, passes: Sequence[Pass],
+               baseline: Optional[Baseline] = None,
+               only_rules: Optional[Set[str]] = None,
+               ) -> Tuple[List[Finding], int, List[str]]:
+    """Run passes; returns (live findings, n_suppressed, unused baseline
+    fingerprints).  Suppressions are resolved against the finding's file;
+    cross-file findings (line 0) can only be baselined."""
+    live: List[Finding] = []
+    suppressed = 0
+    base_fps = baseline.fingerprints() if baseline else set()
+    used_fps: Set[str] = set()
+    for p in passes:
+        for f in p.run(ctx):
+            if only_rules and not any(
+                    f.rule == r or f.rule.startswith(r)
+                    for r in only_rules):
+                continue
+            if f.line and (ctx.root / f.path).suffix == ".py" \
+                    and (ctx.root / f.path).exists() \
+                    and ctx.source(f.path).suppressed(f.rule, f.line):
+                suppressed += 1
+                continue
+            if f.fingerprint() in base_fps:
+                used_fps.add(f.fingerprint())
+                suppressed += 1
+                continue
+            if ctx.changed is not None and f.path not in ctx.changed:
+                # --changed-only: incremental convenience; the full run
+                # (CI) is authoritative for cross-file contract drift.
+                continue
+            live.append(f)
+    # Unused-entry detection is only meaningful on an UNSCOPED run: a
+    # --rules/--changed-only run never sees the findings the skipped
+    # passes/files would have matched, and a "fixed? remove it" warning
+    # for a still-live entry would mislead.
+    scoped = bool(only_rules) or ctx.changed is not None
+    unused = [] if scoped else sorted(base_fps - used_fps)
+    return live, suppressed, unused

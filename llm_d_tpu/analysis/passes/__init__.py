@@ -1,0 +1,26 @@
+"""Pass registry for llmd-check."""
+
+from __future__ import annotations
+
+from typing import List
+
+from llm_d_tpu.analysis.core import Pass
+from llm_d_tpu.analysis.passes.async_blocking import AsyncBlockingPass
+from llm_d_tpu.analysis.passes.dockerfile import DockerfilePass
+from llm_d_tpu.analysis.passes.envvars import EnvVarsPass
+from llm_d_tpu.analysis.passes.headers import HeadersPass
+from llm_d_tpu.analysis.passes.jit_hygiene import JitHygienePass
+from llm_d_tpu.analysis.passes.metrics_registry import MetricsPass
+from llm_d_tpu.analysis.passes.pallas_invariants import PallasPass
+
+
+def all_passes() -> List[Pass]:
+    return [
+        HeadersPass(),
+        MetricsPass(),
+        EnvVarsPass(),
+        JitHygienePass(),
+        AsyncBlockingPass(),
+        PallasPass(),
+        DockerfilePass(),
+    ]

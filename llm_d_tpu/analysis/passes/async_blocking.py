@@ -1,0 +1,143 @@
+"""ASYNC: blocking primitives on event-loop paths.
+
+One blocked event loop stalls EVERY request on that component (the
+gateway, the sidecar, the API server...), so the p99 story of the whole
+stack hinges on nothing synchronous sneaking into a coroutine.  Scope is
+every module that defines an ``async def`` (the stack's ten async
+modules today).
+
+  ASYNC001  blocking call (``time.sleep``, sync HTTP/urllib/requests,
+            subprocess, ``os.system``) lexically inside an ``async def``
+            — including nested sync helpers, which still run on the loop
+            when the coroutine calls them.
+  ASYNC002  a (threading) lock held across ``await``: everything else on
+            the loop that touches the lock now deadlocks or serializes
+            behind a suspended coroutine.  ``async with`` is exempt
+            (asyncio primitives are loop-aware).
+  ASYNC003  ``time.sleep`` anywhere else in an async module — sync
+            helpers in such modules get called from coroutines sooner or
+            later (the faultinject latency rule was exactly this bug);
+            guard for a running loop or provide an async variant, then
+            justify the remaining thread-only sleep with an ignore.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set, Tuple
+
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+_BLOCKING_ATTR_CALLS = {
+    ("time", "sleep"),
+    ("os", "system"),
+    ("subprocess", "run"),
+    ("subprocess", "call"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("socket", "create_connection"),
+    ("requests", "get"),
+    ("requests", "post"),
+    ("requests", "put"),
+    ("requests", "delete"),
+    ("requests", "head"),
+    ("requests", "request"),
+}
+_BLOCKING_BARE = {"urlopen"}    # urllib.request.urlopen
+
+
+def _call_label(node: ast.Call) -> str:
+    """'' or 'mod.attr' label when this is a known blocking call."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _BLOCKING_BARE:
+            return f"...{f.attr}"
+        base = f.value
+        root = base.id if isinstance(base, ast.Name) else (
+            base.attr if isinstance(base, ast.Attribute) else "")
+        if (root, f.attr) in _BLOCKING_ATTR_CALLS:
+            return f"{root}.{f.attr}"
+    return ""
+
+
+def _is_time_sleep(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "sleep"
+            and isinstance(f.value, ast.Name) and f.value.id == "time")
+
+
+class AsyncBlockingPass(Pass):
+    name = "async"
+    rules = {
+        "ASYNC001": "blocking call inside an async def",
+        "ASYNC002": "threading lock held across await",
+        "ASYNC003": ("time.sleep in an async module outside async def — "
+                     "guard for a running loop or provide an async "
+                     "variant"),
+    }
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel in list(ctx.package_files) + list(ctx.script_files):
+            src = ctx.source(rel)
+            tree = src.tree
+            if tree is None:
+                continue
+            async_defs = [n for n in ast.walk(tree)
+                          if isinstance(n, ast.AsyncFunctionDef)]
+            if not async_defs:
+                continue
+            in_async: Set[Tuple[int, int]] = set()
+            seen: Set[Tuple[str, int]] = set()
+            for fn in async_defs:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        in_async.add((node.lineno, node.col_offset))
+                        label = _call_label(node)
+                        key = ("ASYNC001", node.lineno)
+                        if label and key not in seen:
+                            seen.add(key)
+                            findings.append(Finding(
+                                "ASYNC001", rel, node.lineno,
+                                f"blocking {label} inside async "
+                                f"{fn.name!r} — use the asyncio "
+                                f"equivalent or an executor"))
+                    if isinstance(node, ast.With):
+                        findings.extend(self._lock_across_await(
+                            rel, fn.name, node, seen))
+            # ASYNC003: time.sleep in the module's sync remainder.
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) and _is_time_sleep(node) \
+                        and (node.lineno, node.col_offset) not in in_async:
+                    findings.append(Finding(
+                        "ASYNC003", rel, node.lineno,
+                        "time.sleep in an async module; a coroutine "
+                        "calling this helper blocks the whole loop"))
+        return findings
+
+    @staticmethod
+    def _lock_across_await(rel: str, fn_name: str, node: ast.With,
+                           seen: Set[Tuple[str, int]]) -> List[Finding]:
+        has_await = any(isinstance(n, ast.Await)
+                        for stmt in node.body for n in ast.walk(stmt))
+        if not has_await:
+            return []
+        for item in node.items:
+            try:
+                expr = ast.unparse(item.context_expr)
+            except Exception:
+                continue
+            # (?<![a-z]) so 'block'/'_block_pool' (ubiquitous in this
+            # KV-block-centric codebase) never reads as a lock.
+            if re.search(r"(?<![a-z])lock", expr.lower()) \
+                    and "asyncio" not in expr:
+                key = ("ASYNC002", node.lineno)
+                if key in seen:
+                    return []
+                seen.add(key)
+                return [Finding(
+                    "ASYNC002", rel, node.lineno,
+                    f"lock {expr!r} held across await in async "
+                    f"{fn_name!r}")]
+        return []

@@ -1,0 +1,50 @@
+"""DOCKER: the Dockerfile linter, surfaced under the llmd-check CLI.
+
+``scripts/lint-dockerfile.py`` stays the implementation (it predates
+this framework and is regex-shaped by nature — Dockerfiles have no AST);
+this pass adapts its findings into the shared finding/baseline pipeline
+so there is ONE lint entry point and one suppression story.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from typing import List
+
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+
+class DockerfilePass(Pass):
+    name = "docker"
+    rules = {
+        "DOCKER001": "scripts/lint-dockerfile.py finding",
+    }
+
+    def run(self, ctx: Context) -> List[Finding]:
+        lint_path = ctx.root / "scripts" / "lint-dockerfile.py"
+        if not lint_path.exists():
+            return [Finding("DOCKER001", "scripts/lint-dockerfile.py", 0,
+                            "linter script missing")]
+        spec = importlib.util.spec_from_file_location(
+            "llmd_lint_dockerfile", lint_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        registry = {m.group(1): m.group(2).strip()
+                    for m in mod.DOC_RE.finditer(
+                        (ctx.root / "docs" / "ENVVARS.md").read_text())}
+        findings: List[Finding] = []
+        dockerfiles = sorted((ctx.root / "docker").glob("Dockerfile*"))
+        if not dockerfiles:
+            # The old standalone linter exited 1 here; a moved/renamed
+            # docker/ dir must not silently disable all Dockerfile checks.
+            return [Finding("DOCKER001", "docker", 0,
+                            "no Dockerfiles found under docker/")]
+        for df in dockerfiles:
+            rel = df.relative_to(ctx.root).as_posix()
+            for err in mod.lint(df, registry):
+                # lint() prefixes messages with the file name; strip it
+                # so the fingerprint stays stable under path rendering.
+                msg = err.split(": ", 1)[1] if ": " in err else err
+                findings.append(Finding("DOCKER001", rel, 0, msg))
+        return findings

@@ -1,0 +1,199 @@
+"""ENV: the env-knob contract (docs/ENVVARS.md is the registry).
+
+First-class successor of the old ``scripts/lint-envvars.py`` regex
+linter, now AST-based so it can also check DEFAULTS: the shipped
+fallback at the call site (``env_int("LLMD_X", 5)``, including
+``env_choice`` and ``os.environ.get`` defaults, with one-hop resolution
+through module/class constants) must equal the registry row's Default
+column — a doc that promises one default while the code ships another
+is the worst kind of drift, because it only bites in production.
+
+  ENV001  knob read in code, missing from docs/ENVVARS.md
+  ENV002  documented knob read nowhere (stale row)
+  ENV003  knob set in deploy/ manifests that code never reads
+  ENV004  call-site default != registry Default column
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+REGISTRY_DOC = "docs/ENVVARS.md"
+PREFIXES = ("LLMD_", "LWS_")
+_VAR_RE = re.compile(r"^(?:LLMD|LWS)_[A-Z0-9_]+$")
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`((?:LLMD|LWS)_[A-Z0-9_]+)`\s*\|\s*([^|]*)\|", re.M)
+_YAML_ENV_RE = re.compile(r"name:\s*((?:LLMD|LWS)_[A-Z0-9_]+)")
+_HELPER_SUFFIXES = ("env_int", "env_float", "env_choice")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _module_consts(tree: ast.Module
+                   ) -> Tuple[Dict[str, object], Dict[str, List[object]]]:
+    """``NAME = <literal>`` assignments for one-hop default resolution:
+    (module-level name -> value, class-level name -> values across ALL
+    classes).  Class consts stay lists so an ambiguous name (two classes
+    defining the same attribute with different values) resolves to
+    nothing rather than to whichever class happened to come last."""
+    module: Dict[str, object] = {}
+    classes: Dict[str, List[object]] = {}
+
+    def scan(body, out_set):
+        for stmt in body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Constant):
+                out_set(stmt.targets[0].id, stmt.value.value)
+
+    scan(tree.body, module.__setitem__)
+    for n in tree.body:
+        if isinstance(n, ast.ClassDef):
+            scan(n.body, lambda k, v: classes.setdefault(k, []).append(v))
+    return module, classes
+
+
+class EnvVarsPass(Pass):
+    name = "envvars"
+    rules = {
+        "ENV001": "env knob read in code but missing from docs/ENVVARS.md",
+        "ENV002": "env knob documented but read nowhere (stale row)",
+        "ENV003": "env knob set in deploy/ manifests but read nowhere",
+        "ENV004": "call-site default differs from the registry default",
+    }
+
+    def _reads(self, ctx: Context
+               ) -> Tuple[Dict[str, Tuple[str, int]],
+                          List[Tuple[str, int, str, object]]]:
+        """(var -> first read site (rel, line) with call sites preferred
+        over bare mentions, [(rel, line, var, default)] for call sites
+        with a resolvable literal default).  Sites anchor ENV001 findings
+        at the offending READ so --changed-only catches a knob added in
+        the changed file."""
+        call_sites: Dict[str, Tuple[str, int]] = {}
+        mention_sites: Dict[str, Tuple[str, int]] = {}
+        defaults: List[Tuple[str, int, str, object]] = []
+        for rel in list(ctx.package_files) + list(ctx.script_files):
+            src = ctx.source(rel)
+            tree = src.tree
+            if tree is None:
+                continue
+            module_consts, class_consts = _module_consts(tree)
+            for node in ast.walk(tree):
+                # Any literal mention counts as a read (the LWS contract
+                # enters through a dict parameter in mesh.py; scripts
+                # mention knobs in --help epilogs they honor).
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and _VAR_RE.match(node.value):
+                    mention_sites.setdefault(node.value, (rel, node.lineno))
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _call_name(node)
+                is_helper = cname.endswith(_HELPER_SUFFIXES)
+                is_environ_get = (
+                    cname == "get" and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, (ast.Name, ast.Attribute))
+                    and (getattr(node.func.value, "id", "")
+                         or getattr(node.func.value, "attr", "")) == "environ")
+                if not (is_helper or is_environ_get):
+                    continue
+                if not node.args or not isinstance(node.args[0], ast.Constant) \
+                        or not isinstance(node.args[0].value, str):
+                    continue
+                var = node.args[0].value
+                if not _VAR_RE.match(var):
+                    continue
+                call_sites.setdefault(var, (rel, node.lineno))
+                default = self._resolve_default(
+                    node, module_consts, class_consts)
+                if default is not None:
+                    defaults.append((rel, node.lineno, var, default))
+        sites = dict(mention_sites)
+        sites.update(call_sites)    # a real call site beats a bare mention
+        return sites, defaults
+
+    @staticmethod
+    def _resolve_default(node: ast.Call,
+                         module_consts: Dict[str, object],
+                         class_consts: Dict[str, List[object]]
+                         ) -> Optional[object]:
+        if len(node.args) < 2:
+            return None
+        d = node.args[1]
+        if isinstance(d, ast.Constant):
+            return d.value
+        if isinstance(d, ast.Name):
+            return module_consts.get(d.id)
+        if isinstance(d, ast.Attribute):    # self.X / Cls.X -> class const
+            values = class_consts.get(d.attr, [])
+            # Only when unambiguous: two classes sharing the attribute
+            # name with different values must skip the check, not bind
+            # whichever class came last.
+            if len(set(map(repr, values))) == 1:
+                return values[0]
+        return None
+
+    @staticmethod
+    def _defaults_equal(code: object, doc: str) -> bool:
+        doc = doc.strip().strip("`").strip()
+        if doc in ("", "—", "-"):
+            return True     # "no default" rows don't pin a value
+        try:
+            return float(code) == float(doc)  # 600 == 600.0
+        except (TypeError, ValueError):
+            return str(code) == doc
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        sites, defaults = self._reads(ctx)
+        read = set(sites)
+
+        doc_text = ctx.read_text(REGISTRY_DOC) or ""
+        doc_rows: Dict[str, str] = {
+            m.group(1): m.group(2) for m in _DOC_ROW_RE.finditer(doc_text)}
+
+        for var in sorted(read - set(doc_rows)):
+            # Anchored at the offending READ (not the doc) so adding an
+            # undocumented knob is caught even under --changed-only.
+            rel, line = sites[var]
+            findings.append(Finding(
+                "ENV001", rel, line,
+                f"{var} is read in code but has no docs/ENVVARS.md row"))
+        for var in sorted(set(doc_rows) - read):
+            findings.append(Finding(
+                "ENV002", REGISTRY_DOC, 0,
+                f"{var} is documented but read nowhere"))
+
+        manifest_vars: Dict[str, str] = {}
+        for path in sorted((ctx.root / "deploy").rglob("*.yaml")):
+            rel = path.relative_to(ctx.root).as_posix()
+            for var in _YAML_ENV_RE.findall(path.read_text()):
+                manifest_vars.setdefault(var, rel)
+        for var in sorted(set(manifest_vars) - read):
+            findings.append(Finding(
+                "ENV003", manifest_vars[var], 0,
+                f"{var} is set in deploy manifests but read nowhere "
+                f"(dead knob)"))
+
+        for rel, line, var, default in defaults:
+            doc_default = doc_rows.get(var)
+            if doc_default is None:
+                continue    # ENV001 already covers the missing row
+            if not self._defaults_equal(default, doc_default):
+                findings.append(Finding(
+                    "ENV004", rel, line,
+                    f"{var} call-site default {default!r} != registry "
+                    f"default {doc_default.strip().strip('`').strip()!r}"))
+        return findings

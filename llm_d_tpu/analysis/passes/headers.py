@@ -1,0 +1,55 @@
+"""HDR: the wire-header contract.
+
+``utils/lifecycle.py`` is the ONE place the ``x-llmd-*`` /
+``x-prefiller-*`` wire headers are defined (PR 4 doctrine: gateway,
+sidecar, model server, simulator and load generator cannot drift apart
+when they all import the same constant).  Any other string literal in
+those namespaces is a drift seed — a typo'd header silently never
+matches, and a renamed one strands every component still holding the
+old spelling.
+
+Tests are exempt by design: a test asserting the literal wire value is
+the contract being VERIFIED, not duplicated.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+CANONICAL_MODULE = "llm_d_tpu/utils/lifecycle.py"
+_HEADER_RE = re.compile(r"^x-(?:llmd|prefiller)-[a-z0-9-]+$")
+
+
+class HeadersPass(Pass):
+    name = "headers"
+    rules = {
+        "HDR001": ("x-llmd-*/x-prefiller-* string literal outside "
+                   "utils/lifecycle.py — import the canonical constant"),
+    }
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel in list(ctx.package_files) + list(ctx.script_files):
+            if rel == CANONICAL_MODULE:
+                continue
+            src = ctx.source(rel)
+            tree = src.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                if not _HEADER_RE.match(node.value):
+                    continue
+                if node.lineno in src.docstring_lines:
+                    continue
+                findings.append(Finding(
+                    "HDR001", rel, node.lineno,
+                    f"wire-header literal {node.value!r}; import it from "
+                    f"llm_d_tpu.utils.lifecycle"))
+        return findings

@@ -1,0 +1,182 @@
+"""JIT: host-sync hygiene in traced and decode-hot-loop code.
+
+Two scopes, one hazard: a host synchronization on the decode path stalls
+the TPU pipeline for a full device round trip (tens of ms against a
+remote chip — larger than the step it blocks).
+
+  JIT001  ``.item()`` / ``float()``/``int()`` on non-literals /
+          ``np.asarray``/``np.array`` / ``jax.device_get`` lexically
+          inside a jit-decorated function: under trace these either
+          fail or silently constant-fold the wrong thing.
+  JIT002  dtype-less ``jnp.array``/``jnp.asarray`` on a Python literal
+          inside jit or step-reachable code — weak-type promotion
+          hazards that change numerics per call site.
+  JIT003  ``jax.device_get`` / ``.item()`` in a function reachable from
+          ``EngineCore.step`` (call graph over ``self.*()`` calls in
+          engine/engine.py).  The two deliberate sync points (the
+          batched token fetch, the multistep retire) carry explicit
+          ``# llmd: ignore[JIT]`` comments — any NEW host sync in the
+          decode hot loop must be argued for the same way, not land
+          silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set
+
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+ENGINE_MODULE = "llm_d_tpu/engine/engine.py"
+ENGINE_CLASS = "EngineCore"
+STEP_ROOT = "step"
+_NP_NAMES = {"np", "numpy"}
+_JNP_NAMES = {"jnp", "jax.numpy"}
+
+
+def _is_jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        try:
+            if re.search(r"\bjit\b", ast.unparse(dec)):
+                return True
+        except Exception:
+            continue
+    return False
+
+
+def _attr_root(node: ast.expr) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return _attr_root(node.value)
+    return ""
+
+
+class JitHygienePass(Pass):
+    name = "jit"
+    rules = {
+        "JIT001": ("host-sync call (.item()/float()/np.asarray/"
+                   "jax.device_get) inside a jit-decorated function"),
+        "JIT002": ("dtype-less jnp.array literal inside jit or "
+                   "engine-step-reachable code"),
+        "JIT003": ("host sync (jax.device_get/.item()) in a function "
+                   "reachable from EngineCore.step"),
+    }
+
+    # ---- shared call classification ----
+
+    @staticmethod
+    def _host_sync_kind(node: ast.Call) -> str:
+        """'' or a label for a host-sync-shaped call."""
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args:
+                return ".item()"
+            if f.attr == "device_get":
+                return "jax.device_get"
+            if f.attr in ("asarray", "array") \
+                    and _attr_root(f.value) in _NP_NAMES:
+                return f"np.{f.attr}"
+        if isinstance(f, ast.Name) and f.id in ("float", "int") \
+                and node.args and not isinstance(node.args[0], ast.Constant):
+            return f"{f.id}()"
+        return ""
+
+    @staticmethod
+    def _dtypeless_jnp_literal(node: ast.Call) -> bool:
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("array", "asarray")
+                and _attr_root(f.value) in ("jnp", "jax")):
+            return False
+        if not node.args or not isinstance(
+                node.args[0], (ast.List, ast.Tuple, ast.Constant)):
+            return False
+        # Second positional arg is dtype (``jnp.asarray([x], jnp.int32)``).
+        return len(node.args) < 2 \
+            and not any(kw.arg == "dtype" for kw in node.keywords)
+
+    # ---- JIT001 / JIT002 over jit-decorated functions ----
+
+    def _scan_jit_functions(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel in ctx.package_files:
+            src = ctx.source(rel)
+            if src.tree is None:
+                continue
+            for fn in ast.walk(src.tree):
+                if not isinstance(fn, ast.FunctionDef) \
+                        or not _is_jit_decorated(fn):
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    kind = self._host_sync_kind(node)
+                    if kind:
+                        findings.append(Finding(
+                            "JIT001", rel, node.lineno,
+                            f"{kind} inside jit function {fn.name!r} "
+                            f"(host sync under trace)"))
+                    if self._dtypeless_jnp_literal(node):
+                        findings.append(Finding(
+                            "JIT002", rel, node.lineno,
+                            f"dtype-less jnp literal in jit function "
+                            f"{fn.name!r}"))
+        return findings
+
+    # ---- JIT002 / JIT003 over the engine-step call graph ----
+
+    def _step_reachable(self, tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+        methods: Dict[str, ast.FunctionDef] = {}
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == ENGINE_CLASS:
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        methods[item.name] = item
+        reachable: Set[str] = set()
+        frontier = [STEP_ROOT]
+        while frontier:
+            name = frontier.pop()
+            if name in reachable or name not in methods:
+                continue
+            reachable.add(name)
+            for node in ast.walk(methods[name]):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    frontier.append(node.func.attr)
+        return {n: methods[n] for n in reachable}
+
+    def _scan_step_path(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        if ENGINE_MODULE not in ctx.package_files:
+            return findings
+        src = ctx.source(ENGINE_MODULE)
+        if src.tree is None:
+            return findings
+        for name, fn in sorted(self._step_reachable(src.tree).items()):
+            seen: Set[int] = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or node.lineno in seen:
+                    continue
+                f = node.func
+                is_sync = (isinstance(f, ast.Attribute)
+                           and (f.attr == "device_get"
+                                or (f.attr == "item" and not node.args)))
+                if is_sync:
+                    seen.add(node.lineno)
+                    findings.append(Finding(
+                        "JIT003", ENGINE_MODULE, node.lineno,
+                        f"host sync in step-reachable {name!r} — justify "
+                        f"with an explicit ignore or move off the hot loop"))
+                if self._dtypeless_jnp_literal(node):
+                    findings.append(Finding(
+                        "JIT002", ENGINE_MODULE, node.lineno,
+                        f"dtype-less jnp literal in step-reachable "
+                        f"{name!r}"))
+        return findings
+
+    def run(self, ctx: Context) -> List[Finding]:
+        return self._scan_jit_functions(ctx) + self._scan_step_path(ctx)

@@ -1,0 +1,120 @@
+"""MET: the ``llmd_tpu:*`` metric-name registry.
+
+The observability contract is metrics-first (dashboards and the EPP's
+scrape loop key on exact metric names), so the TPU-stack metric family
+has one declaration site — ``utils/metrics.py`` — and every consumer
+(the EPP datastore's scrape keys, the drain filter, the monitoring
+docs) must agree with it:
+
+  MET001  a ``llmd_tpu:*`` literal anywhere else in the package or
+          scripts — consumers import the name constant from
+          ``utils/metrics.py`` instead of respelling it.
+  MET002  a name declared twice in ``utils/metrics.py`` (two collectors
+          competing for one series).
+  MET003  a declared name missing from
+          ``docs/monitoring/example-promql-queries.md`` — a metric no
+          dashboard can discover.
+  MET004  a ``llmd_tpu:*`` name in the monitoring docs that is declared
+          nowhere (stale doc row).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List
+
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+REGISTRY_MODULE = "llm_d_tpu/utils/metrics.py"
+MONITORING_DOC = "docs/monitoring/example-promql-queries.md"
+_NAME_RE = re.compile(r"^llmd_tpu:[a-z0-9_]+$")
+_DOC_NAME_RE = re.compile(r"llmd_tpu:[a-z0-9_]+")
+
+
+class MetricsPass(Pass):
+    name = "metrics"
+    rules = {
+        "MET001": ("llmd_tpu:* literal outside utils/metrics.py — import "
+                   "the name constant from the registry"),
+        "MET002": "llmd_tpu:* name declared more than once in the registry",
+        "MET003": ("declared llmd_tpu:* metric missing from "
+                   "docs/monitoring/example-promql-queries.md"),
+        "MET004": ("llmd_tpu:* name in the monitoring docs that the "
+                   "registry never declares"),
+    }
+
+    def _declared(self, ctx: Context) -> Dict[str, List[int]]:
+        """name -> declaration lines in the registry module (literals
+        only; docstrings exempt)."""
+        out: Dict[str, List[int]] = {}
+        src = ctx.source(REGISTRY_MODULE)
+        if src.tree is None:
+            return out
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and _NAME_RE.match(node.value) \
+                    and node.lineno not in src.docstring_lines:
+                out.setdefault(node.value, []).append(node.lineno)
+        return out
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        declared = self._declared(ctx)
+
+        for name, lines in declared.items():
+            # A module-level name constant + its use inside a collector
+            # declaration is ONE declaration; only repeated literals
+            # count (the constant-reference spelling has no literal).
+            if len(lines) > 1:
+                # No line numbers in the MESSAGE: the baseline fingerprint
+                # is (rule, path, message) and must survive unrelated
+                # edits shifting the declarations.
+                findings.append(Finding(
+                    "MET002", REGISTRY_MODULE, lines[1],
+                    f"metric {name!r} declared {len(lines)} times in "
+                    f"the registry"))
+
+        for rel in list(ctx.package_files) + list(ctx.script_files):
+            if rel == REGISTRY_MODULE:
+                continue
+            src = ctx.source(rel)
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and _NAME_RE.match(node.value) \
+                        and node.lineno not in src.docstring_lines:
+                    findings.append(Finding(
+                        "MET001", rel, node.lineno,
+                        f"metric literal {node.value!r}; import the name "
+                        f"from llm_d_tpu.utils.metrics"))
+
+        doc = ctx.read_text(MONITORING_DOC)
+        if doc is not None:
+            # PromQL references histograms by their exposition series
+            # (``_bucket``/``_count``/``_sum``); fold those back onto the
+            # declared base name.
+            doc_names = set()
+            for name in _DOC_NAME_RE.findall(doc):
+                for suffix in ("_bucket", "_count", "_sum"):
+                    if name.endswith(suffix) \
+                            and name[:-len(suffix)] in declared:
+                        name = name[:-len(suffix)]
+                        break
+                doc_names.add(name)
+            for name in sorted(set(declared) - doc_names):
+                # Anchored at the DECLARATION so a new undocumented
+                # metric is caught even under --changed-only.
+                findings.append(Finding(
+                    "MET003", REGISTRY_MODULE, declared[name][0],
+                    f"declared metric {name!r} has no row/query in "
+                    f"{MONITORING_DOC}"))
+            for name in sorted(doc_names - set(declared)):
+                findings.append(Finding(
+                    "MET004", MONITORING_DOC, 0,
+                    f"documented metric {name!r} is declared nowhere in "
+                    f"{REGISTRY_MODULE}"))
+        return findings

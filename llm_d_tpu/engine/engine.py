@@ -646,7 +646,11 @@ class EngineCore:
         """Synchronize one in-flight block and advance request state."""
         scheduled, K = inflight["scheduled"], inflight["K"]
         rows = inflight["rows"]
-        # [K, S] / [K, dp, S_l] -> [K, S_total] flat rows.
+        # [K, S] / [K, dp, S_l] -> [K, S_total] flat rows.  Deliberate
+        # sync point: retire() exists to materialize this block's tokens,
+        # and the successor block is already dispatched so the device
+        # stays busy while the host syncs.
+        # llmd: ignore[JIT] the one intended multistep-retire host sync
         ids_ks = np.asarray(jax.device_get(inflight["ids_dev"]))
         ids_ks = ids_ks.reshape(K, -1)
         self._step_count += K
@@ -1027,6 +1031,7 @@ class EngineCore:
                       for sr in sched.scheduled)
         fetch = [ids] + ([logprobs] if want_lp else []) \
             + (list(top) if top is not None else [])
+        # llmd: ignore[JIT] the one intended per-step host sync (batched)
         fetched = jax.device_get(fetch)
         ids = np.asarray(fetched[0])
         logprobs = np.asarray(fetched[1]) if want_lp else None

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional
 import aiohttp
 
 from llm_d_tpu.utils.config import env_float, env_int
-from llm_d_tpu.utils.metrics import parse_prometheus_text
+from llm_d_tpu.utils.metrics import DRAIN_STATE_METRIC, parse_prometheus_text
 
 logger = logging.getLogger(__name__)
 
@@ -348,7 +348,7 @@ class Datastore:
             e.num_waiting = m.get("vllm:num_requests_waiting", 0.0)
             e.num_running = m.get("vllm:num_requests_running", 0.0)
             e.kv_usage = m.get(self.kv_usage_metric, 0.0)
-            e.draining = m.get("llmd_tpu:drain_state", 0.0) >= 1.0
+            e.draining = m.get(DRAIN_STATE_METRIC, 0.0) >= 1.0
             e.ready = True
             e.scrape_error = None
             e.last_scrape = time.monotonic()

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from llm_d_tpu.epp.datastore import Datastore, EndpointState
 from llm_d_tpu.utils.hashing import hash_block
+from llm_d_tpu.utils.lifecycle import PREFILLER_HEADER
 
 Scores = Dict[str, float]
 
@@ -555,7 +556,7 @@ class PrefillHeaderHandler(Plugin):
     """Exports the prefill profile's pick as the sidecar's prefill hint
     header (reference: gaie-pd/values.yaml:20 prefill-header-handler)."""
 
-    HEADER = "x-prefiller-host-port"
+    HEADER = PREFILLER_HEADER
 
     def on_picked(self, ctx, endpoint, profile):
         if profile == "prefill":

@@ -41,17 +41,14 @@ from llm_d_tpu.utils.lifecycle import (
     CRITICALITY_HEADER,
     DEADLINE_ABS_HEADER,
     DEADLINE_EXCEEDED_HEADER,
+    RETRY_ATTEMPT_HEADER,
+    RETRY_BUDGET_HEADER,
     parse_criticality,
     parse_deadline,
 )
 from llm_d_tpu.utils.metrics import EppMetrics
 
 logger = logging.getLogger(__name__)
-
-# Retry observability: the attempt index rides to the upstream (log
-# correlation) and the spent/total budget rides back to the client.
-RETRY_ATTEMPT_HEADER = "x-llmd-retry-attempt"
-RETRY_BUDGET_HEADER = "x-llmd-retry-budget"
 
 
 def parse_endpoint_arg(arg: str) -> EndpointState:

@@ -242,6 +242,15 @@ def mla_paged_decode_update(
     caller alongside ``row_scale_new``)."""
     S, H, F = q_eff.shape
     quantized = kv_scale is not None
+    if quantized and block_size % 32:
+        # int8 latent pages pack (32, 128)-tiled; an unaligned page would
+        # tear the deferred whole-page byte splice off-device, where no
+        # exception ever surfaces.  The dispatch (models/mla.py) already
+        # routes such configs to the XLA fallback — this guards direct
+        # callers of the kernel.
+        raise ValueError(
+            f"int8 latent cache requires block_size % 32 == 0, "
+            f"got {block_size}")
     squeeze = kv_cache.ndim == 2
     if squeeze:
         kv_cache = kv_cache[None]

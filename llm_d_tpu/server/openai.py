@@ -38,6 +38,7 @@ from llm_d_tpu.utils.config import env_float, env_int
 from llm_d_tpu.utils.lifecycle import (
     DEADLINE_EXCEEDED_HEADER,
     DRAINING_HEADER,
+    SCHED_DEPTH_HEADER,
     parse_criticality,
     parse_deadline,
 )
@@ -94,7 +95,7 @@ class DPWorkerPool:
     # Shipped default; instances read the LLMD_WORKER_BACKOFF_S env knob
     # (invalid values fall back here).
     WORKER_BACKOFF_S = 15.0
-    DEPTH_HEADER = "x-llmd-sched-depth"
+    DEPTH_HEADER = SCHED_DEPTH_HEADER
 
     def __init__(self, workers: List[str]) -> None:
         from llm_d_tpu.utils.config import env_float

@@ -36,6 +36,8 @@ from llm_d_tpu.utils.lifecycle import (
     CRITICALITY_HEADER,
     DEADLINE_ABS_HEADER,
     DEADLINE_EXCEEDED_HEADER,
+    PREFILL_FALLBACK_HEADER,
+    PREFILLER_HEADER,
     parse_criticality,
     parse_deadline,
     remaining_s,
@@ -43,8 +45,8 @@ from llm_d_tpu.utils.lifecycle import (
 
 logger = logging.getLogger(__name__)
 
-PREFILLER_HEADER = "x-prefiller-host-port"
-FALLBACK_HEADER = "x-llmd-prefill-fallback"
+# Historic local alias (tests and operators know this name).
+FALLBACK_HEADER = PREFILL_FALLBACK_HEADER
 
 # Hop-by-hop headers a proxy must not forward verbatim.
 _HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",

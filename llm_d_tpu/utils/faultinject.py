@@ -231,12 +231,32 @@ class FaultInjector:
 
     def check(self, point: str, key: str = "") -> None:
         """Sync fault point (engine thread / worker threads).  May sleep
-        (injected latency) and may raise :class:`FaultInjected`."""
+        (injected latency) and may raise :class:`FaultInjected`.
+
+        Async-aware: a latency rule firing on an EVENT-LOOP thread must
+        not ``time.sleep`` — that stalls every other request on the
+        component, so one injected 50 ms stall distorts the p99 of the
+        whole chaos run.  Coroutine callers use :meth:`acheck` (which
+        awaits the stall); if a sync call site turns out to run on the
+        loop anyway, the stall is skipped with a warning instead of
+        poisoning the loop."""
         if not self._rules:
             return
         fire, latency, label = self._decide(point, key)
         if latency > 0:
-            time.sleep(latency)
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                # Plain worker/engine thread: blocking is the point — the
+                # injected stall mimics a slow peer or device.
+                # llmd: ignore[ASYNC] thread-context only; loop-guarded
+                time.sleep(latency)
+            else:
+                logger.warning(
+                    "faultinject: latency rule at %s fired on an event-"
+                    "loop thread; use 'await acheck()' — skipping the "
+                    "%.3fs stall instead of blocking the loop",
+                    point, latency)
         if fire:
             raise FaultInjected(point, key, label)
 

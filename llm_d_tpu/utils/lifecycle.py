@@ -23,11 +23,27 @@ drift apart:
                          (body field ``criticality`` is the alias).
   x-llmd-draining        response marker: the replica refused new work
                          because it is draining.
+  x-llmd-sched-depth     response header: the replica's self-reported
+                         scheduler depth (waiting + running), consumed
+                         by the DP leader's least-outstanding-work pool.
+  x-llmd-retry-attempt   request header: gateway retry attempt index
+                         (upstream log correlation).
+  x-llmd-retry-budget    response header: spent/total gateway retry
+                         budget reported back to the client.
+  x-prefiller-host-port  EPP -> sidecar prefill hint: comma-RANKED
+                         ``host:port`` list (winner first, failover
+                         alternates after).
+  x-llmd-prefill-fallback  response marker: every prefiller failed and
+                         the decode pod recomputed the prefill locally.
 
 Criticality maps to priority *tiers* consumed by the engine scheduler's
 ``(priority, arrival)`` queue order and by preemption victim selection:
 critical outranks standard outranks sheddable, and a request's own
 ``priority`` int breaks ties within its class.
+
+This module is the ONLY place these header strings may appear as
+literals — ``llmd-check`` pass HDR (llm_d_tpu/analysis/passes/headers.py)
+fails CI on any ``x-llmd-*`` / ``x-prefiller-*`` literal elsewhere.
 """
 
 from __future__ import annotations
@@ -40,6 +56,11 @@ DEADLINE_MS_HEADER = "x-llmd-deadline-ms"
 DEADLINE_ABS_HEADER = "x-llmd-deadline"
 DEADLINE_EXCEEDED_HEADER = "x-llmd-deadline-exceeded"
 DRAINING_HEADER = "x-llmd-draining"
+SCHED_DEPTH_HEADER = "x-llmd-sched-depth"
+RETRY_ATTEMPT_HEADER = "x-llmd-retry-attempt"
+RETRY_BUDGET_HEADER = "x-llmd-retry-budget"
+PREFILLER_HEADER = "x-prefiller-host-port"
+PREFILL_FALLBACK_HEADER = "x-llmd-prefill-fallback"
 
 CRITICALITY_CRITICAL = "critical"
 CRITICALITY_STANDARD = "standard"

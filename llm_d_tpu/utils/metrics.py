@@ -25,6 +25,12 @@ from prometheus_client import (
     generate_latest,
 )
 
+# Canonical ``llmd_tpu:*`` names consumed OUTSIDE this module (the EPP's
+# scrape loop keys on the exact string).  llmd-check pass MET forbids
+# respelling any ``llmd_tpu:*`` name outside this file — consumers import
+# these constants.
+DRAIN_STATE_METRIC = "llmd_tpu:drain_state"
+
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
     0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25, 0.5,
@@ -122,7 +128,7 @@ class EngineMetrics:
             "In-flight requests still completing while this replica "
             "drains (0 when not draining or drained).")
         self.drain_state = gauge(
-            "llmd_tpu:drain_state",
+            DRAIN_STATE_METRIC,
             "1 while this replica is draining (readiness down, in-flight "
             "completing); the EPP's drain-filter keys on this.")
 

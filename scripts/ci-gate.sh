@@ -6,8 +6,13 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python -m compileall -q llm_d_tpu tests scripts bench.py __graft_entry__.py
-python scripts/lint-envvars.py
-python scripts/lint-dockerfile.py
+# llmd-check: the contract-enforcing static-analysis suite (wire headers,
+# metric registry, env knobs, jit/host-sync hygiene, async blocking,
+# Pallas DMA invariants, Dockerfiles).  Fail-fast BEFORE any test
+# collection: contract drift is cheaper to report in <1s than to debug
+# through a red integration suite.  (scripts/lint-envvars.py and
+# lint-dockerfile.py are absorbed as passes ENV / DOCKER.)
+python scripts/llmd_check.py
 for f in scripts/*.sh docs/monitoring/scripts/*.sh; do bash -n "$f"; done
 # Resilience + lifecycle gates first, fail-fast (injected fault schedules
 # against the sim stack + tiny engines; deadline/SLO-class/drain contract;

@@ -31,10 +31,20 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import pathlib
 import random
+import sys
 import time
 
 import aiohttp
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from llm_d_tpu.utils.lifecycle import (  # noqa: E402
+    CRITICALITY_HEADER,
+    DEADLINE_EXCEEDED_HEADER,
+    DEADLINE_MS_HEADER,
+)
 
 WORDS = ("tpu mesh shard flash ring latent expert router block cache "
          "prefill decode gateway").split()
@@ -55,9 +65,9 @@ def make_body(args, rng: random.Random) -> tuple:
     criticality = "standard"
     if args.criticality_list:
         criticality = pick_criticality(args.criticality_list, rng)
-        headers["x-llmd-criticality"] = criticality
+        headers[CRITICALITY_HEADER] = criticality
     if args.deadline_ms > 0:
-        headers["x-llmd-deadline-ms"] = str(args.deadline_ms)
+        headers[DEADLINE_MS_HEADER] = str(args.deadline_ms)
     if args.shape == "prefix":
         group = rng.randrange(args.prefix_groups)
         prompt = (f"shared-prefix-{group} " * args.prefix_len
@@ -148,7 +158,7 @@ async def one_request(session, args, rng, stats) -> None:
                 await resp.read()
                 stats[resp.status] = stats.get(resp.status, 0) + 1
                 if resp.status == 504 or resp.headers.get(
-                        "x-llmd-deadline-exceeded"):
+                        DEADLINE_EXCEEDED_HEADER):
                     cls["deadline_miss"] += 1
     except Exception:
         stats["error"] = stats.get("error", 0) + 1

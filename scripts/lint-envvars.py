@@ -1,81 +1,38 @@
 #!/usr/bin/env python3
-"""Env-var registry linter (the reference's lint-envvars.py role).
+"""Env-var registry linter — thin shim over llmd-check pass ENV.
 
-Fails when an ``LLMD_*`` or ``LWS_*`` variable is (a) read anywhere in
-``llm_d_tpu/`` but missing from ``docs/ENVVARS.md``, or (b) documented
-there but read nowhere — both directions of drift.  Deploy manifests are
-also scanned: an env var set in YAML that the code never reads is a dead
-knob an operator will waste hours on.
+The original regex linter grew into the first-class AST pass
+``llm_d_tpu/analysis/passes/envvars.py`` (same both-directions drift
+checks, plus call-site default consistency).  This entry point survives
+for muscle memory and old automation; the real gate is::
 
-Reference doctrine: /root/reference/scripts/lint-envvars.py,
-scripts/ENVVARS.md ("config surface is API surface").
+    python scripts/llmd_check.py            # all passes
+    python scripts/llmd_check.py --rules ENV   # just this one
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-PREFIXES = ("LLMD_", "LWS_")
+sys.path.insert(0, str(REPO))
 
-READ_RE = re.compile(
-    r"environ(?:\.get\(|\[)\s*\"((?:%s)[A-Z0-9_]+)\"" %
-    "|".join(PREFIXES))
-# The config helpers (env_int / env_float / env_choice, invalid-value
-# fallback) are the blessed way to read a knob — their call sites ARE
-# reads, and a knob read only through them must still be documented.
-HELPER_RE = re.compile(
-    r"env_(?:int|float|choice)\(\s*\"((?:%s)[A-Z0-9_]+)\"" % "|".join(PREFIXES))
-DOC_RE = re.compile(r"^\|\s*`((?:%s)[A-Z0-9_]+)`" % "|".join(PREFIXES),
-                    re.M)
-YAML_ENV_RE = re.compile(r"name:\s*((?:%s)[A-Z0-9_]+)" % "|".join(PREFIXES))
+from llm_d_tpu.analysis import Baseline, Context, run_passes  # noqa: E402
+from llm_d_tpu.analysis.passes.envvars import EnvVarsPass  # noqa: E402
 
 
 def main() -> int:
-    read = set()
-    # scripts/ ships operator tooling (load generator, benches): a knob
-    # read there is as load-bearing as one read in the package.
-    sources = list((REPO / "llm_d_tpu").rglob("*.py")) \
-        + list((REPO / "scripts").glob("*.py"))
-    for path in sources:
-        text = path.read_text()
-        read |= set(READ_RE.findall(text))
-        read |= set(HELPER_RE.findall(text))
-    # The LWS contract enters through a dict parameter in mesh.py; catch
-    # plain-string reads too.
-    for path in (REPO / "llm_d_tpu").rglob("*.py"):
-        for var in re.findall(r"\"((?:LLMD|LWS)_[A-Z0-9_]+)\"",
-                              path.read_text()):
-            read.add(var)
-
-    doc_text = (REPO / "docs" / "ENVVARS.md").read_text()
-    documented = set(DOC_RE.findall(doc_text))
-
-    manifest_set = set()
-    for path in (REPO / "deploy").rglob("*.yaml"):
-        manifest_set |= set(YAML_ENV_RE.findall(path.read_text()))
-
-    rc = 0
-    undocumented = read - documented
-    if undocumented:
-        rc = 1
-        print(f"UNDOCUMENTED (read in code, absent from docs/ENVVARS.md): "
-              f"{sorted(undocumented)}")
-    stale = documented - read
-    if stale:
-        rc = 1
-        print(f"STALE (documented, read nowhere): {sorted(stale)}")
-    dead_knobs = manifest_set - read
-    if dead_knobs:
-        rc = 1
-        print(f"DEAD MANIFEST KNOBS (set in deploy/, read nowhere): "
-              f"{sorted(dead_knobs)}")
-    if rc == 0:
-        print(f"ok: {len(read)} vars read, all documented; "
-              f"{len(manifest_set)} set in manifests, all live")
-    return rc
+    ctx = Context(REPO)
+    findings, _, _ = run_passes(
+        ctx, [EnvVarsPass()],
+        baseline=Baseline(REPO / ".llmd-check-baseline.json"))
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        print(f"lint-envvars: {f.render()}", file=sys.stderr)
+    if findings:
+        return 1
+    print("lint-envvars: ok (via llmd-check pass ENV)")
+    return 0
 
 
 if __name__ == "__main__":

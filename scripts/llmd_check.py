@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""llmd-check: the one lint entry point for the whole stack.
+
+Runs the contract-enforcing static-analysis suite
+(``llm_d_tpu/analysis/``) over the repo: wire-header contract, metric
+registry, env-knob registry, jit/host-sync hygiene, async blocking,
+Pallas kernel invariants, Dockerfile checks.  Run fail-fast by
+``scripts/ci-gate.sh`` before any test collection.
+
+  python scripts/llmd_check.py                 # full run (CI mode)
+  python scripts/llmd_check.py --changed-only  # git-diff-scoped, sub-second
+  python scripts/llmd_check.py --rules HDR,MET # subset of rule families
+  python scripts/llmd_check.py --list-rules    # rule table
+  python scripts/llmd_check.py --write-baseline  # snapshot current findings
+
+Suppression: ``# llmd: ignore[RULE]`` on the finding's line or the line
+above.  Baseline: ``.llmd-check-baseline.json`` (kept empty by policy —
+see docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from llm_d_tpu.analysis import (  # noqa: E402
+    Baseline,
+    Context,
+    all_passes,
+    run_passes,
+)
+
+BASELINE_PATH = REPO / ".llmd-check-baseline.json"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "llmd_check", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--changed-only", action="store_true",
+                   help="only report findings in files changed vs HEAD "
+                        "(incremental convenience; the full run is "
+                        "authoritative)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids or family prefixes "
+                        "(e.g. HDR,JIT003)")
+    p.add_argument("--baseline", default=str(BASELINE_PATH),
+                   help="accepted-findings file (default: "
+                        ".llmd-check-baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="snapshot current findings into the baseline "
+                        "file instead of failing (each entry then needs "
+                        "a hand-written reason)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    args = p.parse_args(argv)
+
+    passes = all_passes()
+    if args.list_rules:
+        for ps in passes:
+            for rule, doc in sorted(ps.rules.items()):
+                print(f"{rule:10s} [{ps.name}] {doc}")
+        return 0
+
+    only = ({r.strip() for r in args.rules.split(",") if r.strip()}
+            if args.rules else None)
+    if only:
+        # A typo'd token would silently filter everything and report a
+        # lying 'clean'; every token must name a known rule or family.
+        known = {rule for ps in passes for rule in ps.rules}
+        bad = sorted(t for t in only
+                     if not any(r == t or r.startswith(t) for r in known))
+        if bad:
+            print(f"llmd-check: unknown rule/prefix: {', '.join(bad)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+    if args.write_baseline and (only or args.changed_only):
+        # A scoped snapshot would omit every finding the skipped passes/
+        # files still produce, un-baselining them on the next full run.
+        print("llmd-check: --write-baseline requires an unscoped run "
+              "(no --rules / --changed-only)", file=sys.stderr)
+        return 2
+    ctx = Context(REPO, changed_only=args.changed_only)
+    baseline = Baseline(pathlib.Path(args.baseline))
+    findings, suppressed, unused = run_passes(
+        ctx, passes, baseline=baseline, only_rules=only)
+
+    if args.write_baseline:
+        Baseline.write(pathlib.Path(args.baseline), findings,
+                       existing=baseline.entries)
+        print(f"llmd-check: wrote {len(findings)} new finding(s) to "
+              f"{args.baseline} (existing entries preserved); add a "
+              f"reason to each new entry")
+        return 0
+
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        print(f"llmd-check: {f.render()}", file=sys.stderr)
+    for fp in unused:
+        print(f"llmd-check: warning: unused baseline entry {fp!r} "
+              f"(fixed? remove it)", file=sys.stderr)
+    if findings:
+        print(f"llmd-check: {len(findings)} finding(s) "
+              f"({suppressed} suppressed/baselined)", file=sys.stderr)
+        return 1
+    scope = "changed files" if args.changed_only else "full tree"
+    print(f"llmd-check: clean ({scope}; {suppressed} suppressed/baselined, "
+          f"{len(ctx.package_files) + len(ctx.script_files)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,6 +129,56 @@ def test_fault_latency_only_rule():
     assert time.monotonic() - t0 >= 0.045
 
 
+def test_fault_latency_rule_is_loop_safe():
+    """A latency rule firing through sync check() on an EVENT-LOOP thread
+    must not block the loop (that would stall every request on the
+    component and distort the chaos suite's p99): the stall is skipped
+    with a warning; acheck() awaits the stall without blocking."""
+    import asyncio
+    import time
+
+    inj = FaultInjector(seed=0)
+    inj.add_rule("kv.pull", latency_s=0.2, label="none")
+
+    async def sync_check_on_loop():
+        t0 = time.monotonic()
+        inj.check("kv.pull")        # loop-guarded: no 0.2s stall
+        return time.monotonic() - t0
+
+    assert asyncio.run(sync_check_on_loop()) < 0.15
+
+    async def acheck_keeps_loop_alive():
+        # The awaited stall must suspend only THIS coroutine: a
+        # concurrent ticker keeps running while acheck sleeps.
+        ticks = 0
+
+        async def ticker():
+            nonlocal ticks
+            for _ in range(10):
+                await asyncio.sleep(0.01)
+                ticks += 1
+
+        t = asyncio.ensure_future(ticker())
+        t0 = time.monotonic()
+        await inj.acheck("kv.pull")
+        stalled = time.monotonic() - t0
+        # Snapshot BEFORE awaiting the ticker: if acheck regressed to a
+        # blocking sleep, the ticker would only run afterwards and this
+        # count would be 0.
+        ticks_during_stall = ticks
+        await t
+        return stalled, ticks_during_stall
+
+    stalled, ticks_during_stall = asyncio.run(acheck_keeps_loop_alive())
+    assert stalled >= 0.15 and ticks_during_stall > 0
+
+    # Off-loop (worker thread) sync check still blocks — that is the
+    # point of a latency fault against a thread-context hop.
+    t0 = time.monotonic()
+    inj.check("kv.pull")
+    assert time.monotonic() - t0 >= 0.15
+
+
 # ---------------------------------------------------------------------------
 # circuit breaker: lifecycle + filter semantics (no servers)
 # ---------------------------------------------------------------------------
