@@ -20,6 +20,14 @@ can miss:
           importing module that calls the kernel) named by a test file
           that exercises interpret mode.  CPU interpret parity is the
           only pre-chip numerics gate this repo has.
+
+Coverage extends beyond ``ops/pallas``: int8 *wire-format* modules
+(``INT8_WIRE_MODULES`` — round 10 adds the quantized-collective helpers)
+carry the same PAL002/PAL003 obligations.  A chunked int8 exchange with
+an ungated split corrupts rows off-device exactly like an ungated page
+splice, and the CPU parity suite is likewise its only pre-chip gate —
+coverage is counted through glue entry points such as
+``expert_ffn_a2a`` the same way kernel glue is.
 """
 
 from __future__ import annotations
@@ -32,6 +40,9 @@ from typing import Dict, List, Set
 from llm_d_tpu.analysis.core import Context, Finding, Pass
 
 KERNEL_DIR = "llm_d_tpu/ops/pallas"
+# Non-Pallas modules holding int8 wire formats: same divisibility-gate
+# and parity-test obligations as the kernels (PAL002/PAL003).
+INT8_WIRE_MODULES = ("llm_d_tpu/parallel/quant_collectives.py",)
 
 
 def _has_mod_gate(tree: ast.Module) -> bool:
@@ -65,7 +76,8 @@ class PallasPass(Pass):
 
     def run(self, ctx: Context) -> List[Finding]:
         findings: List[Finding] = []
-        kernels = self._kernel_modules(ctx)
+        kernels = self._kernel_modules(ctx) + [
+            rel for rel in INT8_WIRE_MODULES if rel in ctx.package_files]
         interpret_tests = [rel for rel in ctx.test_files
                            if "interpret" in ctx.source(rel).text]
         test_text = "\n".join(ctx.source(rel).text

@@ -268,6 +268,40 @@ class EngineCore:
             max_num_batched_tokens=config.max_num_batched_tokens,
             max_model_len=c.max_model_len)
         self.metrics = metrics or EngineMetrics(c.name)
+        # EP interconnect accounting (round 10): on a multi-device mesh
+        # every computed token's k routed copies cross the dispatch and
+        # combine exchanges once per MoE layer — estimate the wire bytes
+        # at the resolved collective dtype and export them as
+        # llmd_tpu:collective_bytes_total (the byte model is
+        # parallel/quant_collectives.py; single-device engines ship no
+        # collective bytes).
+        self._collective_wire = None
+        if c.is_moe and self.mesh.devices.size > 1:
+            from llm_d_tpu.parallel.quant_collectives import (
+                a2a_row_bytes, psum_bytes_per_token,
+                resolve_collective_dtype)
+            self._collective_wire = resolve_collective_dtype()
+            Lm = c.num_layers - c.first_dense_layers
+            ep = self.mesh.devices.size
+            if c.num_experts % ep == 0 and ep & (ep - 1) == 0:
+                # a2a-eligible mesh: engine token buckets are powers of
+                # two (>= min_token_bucket), so a power-of-two ep makes
+                # dispatch='auto' pick a2a on every step — charge the
+                # dispatch/combine model.  (E % ep always holds when the
+                # engine builds: the expert weights shard over the EP
+                # axes.)
+                row = a2a_row_bytes(c.hidden_size, self._collective_wire)
+                self._a2a_token_bytes = {
+                    phase: b * c.num_experts_per_tok * Lm
+                    for phase, b in row.items()}
+            else:
+                # A non-power-of-two ep never divides the token buckets,
+                # so EVERY step runs the psum fallback: charge the
+                # allreduce model (k-independent, full activation) so
+                # the dashboard reads what the slice actually ships.
+                self._a2a_token_bytes = {
+                    "allreduce": psum_bytes_per_token(
+                        c.hidden_size, self._collective_wire) * Lm}
 
         # --- device state ---
         self.model = get_model(c)       # models.llama (dense) or models.moe
@@ -687,6 +721,11 @@ class EngineCore:
             # Tokens past a stop are discarded; their KV writes live in
             # already-allocated blocks and are freed with the request.
             self.metrics.generation_tokens.inc(len(new_tokens))
+            # The fused block COMPUTED all K steps for this row on
+            # device regardless of where the stop landed — all K tokens
+            # crossed the EP wire, so all K are charged (generation
+            # counts only the kept tokens above).
+            self._account_collective_bytes(K)
             if req.last_token_time is not None:
                 self.metrics.inter_token_latency.observe(
                     (now - req.last_token_time) / max(1, len(new_tokens)))
@@ -1055,6 +1094,7 @@ class EngineCore:
             s = int(rows[i])
             req, n = sr.request, sr.num_new_tokens
             req.num_computed_tokens += n
+            self._account_collective_bytes(n)
             produced_token = req.num_computed_tokens == req.num_tokens
             self.kv_manager.cache_full_blocks(req)
             if not produced_token:
@@ -1147,6 +1187,16 @@ class EngineCore:
         if req.num_tokens >= self.model_config.max_model_len:
             return RequestState.FINISHED_LENGTH.value
         return None
+
+    def _account_collective_bytes(self, n_tokens: int) -> None:
+        """Charge ``n_tokens`` computed tokens' EP exchange bytes to
+        llmd_tpu:collective_bytes_total (no-op off the multi-device MoE
+        path)."""
+        if self._collective_wire is None or not n_tokens:
+            return
+        for phase, b in self._a2a_token_bytes.items():
+            self.metrics.add_collective_bytes(
+                phase, self._collective_wire, n_tokens * b)
 
     def _update_queue_metrics(self) -> None:
         if self.host_tier is not None:

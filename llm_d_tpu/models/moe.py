@@ -119,6 +119,9 @@ def forward(
     mesh: Optional[Mesh] = None,
     collect_routed: bool = False,   # also return [Lm, T, k] routed ids (EPLB)
     moe_opts: Optional[Dict] = None,   # {"dbo_{decode,prefill}_min_tokens"}
+    collect_moe_trace: bool = False,   # also return per-MoE-layer dispatch
+                                       # inputs (the collective accuracy
+                                       # harness's real-trace capture)
 ):
     c = config
     Ld = c.first_dense_layers
@@ -229,6 +232,13 @@ def forward(
         if "shared_gate" in lp and "shared_expert" not in stub:
             m = m + L.swiglu_mlp(hn, lp["shared_gate"], lp["shared_up"],
                                  lp["shared_down"])
+        if collect_moe_trace:
+            # The EXACT operands the EP dispatch ships: the rms-normed
+            # hidden rows plus the routing the combine applies — what the
+            # collective accuracy harness measures quantization against
+            # (ops/collective_accuracy.py).
+            return (h + m, caches, li + 1), {
+                "x": ht, "weights": weights, "idx": phys_idx}
         return (h + m, caches, li + 1), idx
 
     ml = params["moe_layers"]
@@ -252,6 +262,10 @@ def forward(
     else:
         sample_hidden = x[batch["sample_idx"]]
     out_cache = dict(zip(cache_keys, caches))
+    if collect_moe_trace:
+        # {"x": [Lm, T, H], "weights": [Lm, T, k], "idx": [Lm, T, k]} —
+        # the harness's real routed trace (see moe_body).
+        return sample_hidden, out_cache, routed
     if collect_routed:
         # [Lm, T, k] logical ids for the engine's EPLB LoadTracker.
         return sample_hidden, out_cache, routed

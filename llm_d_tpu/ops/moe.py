@@ -25,11 +25,18 @@ wide-ep decode.yaml:76-132).  Design:
         ``VLLM_MOE_DP_CHUNK_SIZE`` analogue, decode.yaml:108-118) to bound
         the exchange buffers.  XLA:CPU has no ragged-all-to-all, so tests
         run the same fixed-region layout through a dense ``all_to_all``
-        (identical math, padded comm volume).
+        (identical math, padded comm volume).  The exchange WIRE is
+        dtype-selectable (``LLMD_COLLECTIVE_DTYPE``, the EQuARX trade):
+        int8 mode ships per-row-quantized payloads both ways with f32
+        scale vectors as sibling exchanges; bf16 mode ships bf16 both
+        ways (the combine return was f32 before round 10 — the baseline
+        accounting in parallel/quant_collectives.py keeps that number).
 
       * ``psum`` (oracle / fallback): each shard computes all T tokens
         against its local experts and partial outputs all-reduce.  Kept as
         the correctness oracle and for shapes the a2a path can't split.
+        Under the int8 wire mode the all-reduce runs quantized too
+        (``quantized_psum``).
 """
 
 from __future__ import annotations
@@ -44,6 +51,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from llm_d_tpu.models.config import ModelConfig
 from llm_d_tpu.parallel.mesh import AXIS_EP
+from llm_d_tpu.parallel.quant_collectives import (
+    dequantize_rows, quantize_rows, quantized_psum,
+    resolve_collective_dtype)
 from llm_d_tpu.utils.jax_compat import shard_map
 
 
@@ -537,6 +547,7 @@ def _a2a_moe_chunk(
     ragged: bool,
     quant: Optional[dict] = None,  # local int8 payloads [Lm, E_loc, ...]
     interpret: bool = False,
+    wire: str = "bf16",            # resolved collective wire mode
 ) -> jax.Array:            # [Tc, H] f32
     """One chunk of the sparse dispatch/compute/combine pipeline.
 
@@ -550,12 +561,28 @@ def _a2a_moe_chunk(
     int8 kernel on the received rows (arrival order, k=1 routing with
     validity as the combine weight) — no sort, no ragged_dot, no
     materialized dequant on the wide-EP path either.
+
+    ``wire`` quantizes the exchanges themselves (the EQuARX trade,
+    parallel/quant_collectives.py): ``int8`` ships per-row-quantized
+    payloads BOTH ways with the f32 scale vector as a sibling exchange
+    riding the exact same offsets as the payload (so ragged and dense
+    fallback deliver identical rows); ``int8-dispatch`` quantizes only
+    the outbound leg (the microbench A/B lever).  Arriving int8 rows are
+    dequantized before the expert FFN — SwiGLU is nonlinear, so the row
+    scale cannot ride into the combine weight the way a linear op would
+    allow; the dequant is one VPU pass over rows this path materializes
+    in bf16 anyway, and the wire still moved ~0.5x (dispatch) / ~0.25x
+    (combine vs the old f32 return) the bytes.  Combine weights are
+    applied at the origin AFTER dequantization, so wire error never
+    compounds through the weighting.
     """
     Tc, H = x_c.shape
     k = idx_c.shape[1]
     E_loc = (quant["w_gate_q"].shape[1] if quant is not None
              else w_gate.shape[0])
     S = Tc * k
+    quant_dispatch = wire in ("int8", "int8-dispatch")
+    quant_combine = wire == "int8"
 
     flat = idx_c.reshape(S)
     dest = (flat // E_loc).astype(jnp.int32)
@@ -571,6 +598,11 @@ def _a2a_moe_chunk(
     recv_sizes = all_counts[:, my_rank]
 
     payload = x_c[tok_s]                            # [S, H]
+    if quant_dispatch:
+        # Per-row symmetric int8 + f32 scale vector (the KV-cache scale
+        # machinery); the scale plane is a sibling exchange on the same
+        # offsets, like the expert-id plane below.
+        payload, payload_s = quantize_rows(payload)
     if ragged:
         output_offsets = (my_rank * S) * jnp.ones(ep, jnp.int32)
         recv_x = jax.lax.ragged_all_to_all(
@@ -581,6 +613,11 @@ def _a2a_moe_chunk(
             eloc_s, jnp.zeros(ep * S, jnp.int32),
             input_offsets, send_counts, output_offsets, recv_sizes,
             axis_name=AXIS_EP)
+        if quant_dispatch:
+            recv_xs = jax.lax.ragged_all_to_all(
+                payload_s, jnp.zeros(ep * S, jnp.float32),
+                input_offsets, send_counts, output_offsets, recv_sizes,
+                axis_name=AXIS_EP)
     else:
         within = jnp.arange(S, dtype=jnp.int32) - input_offsets[dest_s]
         pidx = dest_s * S + within
@@ -590,6 +627,14 @@ def _a2a_moe_chunk(
         recv_e = jax.lax.all_to_all(
             jnp.zeros(ep * S, jnp.int32).at[pidx].set(eloc_s),
             AXIS_EP, split_axis=0, concat_axis=0, tiled=True)
+        if quant_dispatch:
+            recv_xs = jax.lax.all_to_all(
+                jnp.zeros(ep * S, jnp.float32).at[pidx].set(payload_s),
+                AXIS_EP, split_axis=0, concat_axis=0, tiled=True)
+    if quant_dispatch:
+        # Dequantize on arrival (see docstring); invalid region tails
+        # carry zero scales and dequantize to exact zero rows.
+        recv_x = dequantize_rows(recv_x, recv_xs, x_c.dtype)
 
     # Expert FFN over received rows (invalid region tails contribute 0).
     rows = ep * S
@@ -623,20 +668,42 @@ def _a2a_moe_chunk(
             y)                                               # arrival order
 
     # Combine: results travel back by the exact reverse exchange; weights
-    # are applied at the origin (they never cross the wire).
+    # are applied at the origin (they never cross the wire).  The wire
+    # never ships f32: int8 + scales in quantized mode, else a bf16
+    # downcast — f32 accumulation (weighting + the k-sum scatter) happens
+    # only AFTER arrival, so the baseline pays half the old return bytes
+    # at one bf16 rounding of the expert output.
+    if quant_combine:
+        y_wire, y_s = quantize_rows(y)
+    else:
+        y_wire = y.astype(jnp.bfloat16)
     if ragged:
         # On this shard, rows to return to shard d sit at region d (d*S);
         # they must land at d's original send offsets toward us.
         excl_dst = jnp.cumsum(all_counts, axis=1) - all_counts
         ret = jax.lax.ragged_all_to_all(
-            y, jnp.zeros((S, H), jnp.float32),
+            y_wire, jnp.zeros((S, H), y_wire.dtype),
             jnp.arange(ep, dtype=jnp.int32) * S, recv_sizes,
             excl_dst[:, my_rank], send_counts,
             axis_name=AXIS_EP)                               # [S, H]
+        if quant_combine:
+            ret_s = jax.lax.ragged_all_to_all(
+                y_s, jnp.zeros(S, jnp.float32),
+                jnp.arange(ep, dtype=jnp.int32) * S, recv_sizes,
+                excl_dst[:, my_rank], send_counts,
+                axis_name=AXIS_EP)
     else:
         ret_pad = jax.lax.all_to_all(
-            y, AXIS_EP, split_axis=0, concat_axis=0, tiled=True)
+            y_wire, AXIS_EP, split_axis=0, concat_axis=0, tiled=True)
         ret = ret_pad[pidx]                                  # [S, H]
+        if quant_combine:
+            ret_s = jax.lax.all_to_all(
+                y_s, AXIS_EP, split_axis=0, concat_axis=0, tiled=True
+            )[pidx]
+    if quant_combine:
+        ret = dequantize_rows(ret, ret_s)                    # [S, H] f32
+    else:
+        ret = ret.astype(jnp.float32)
 
     contrib = ret * w_c.reshape(S)[order][:, None]
     return jnp.zeros((Tc, H), jnp.float32).at[tok_s].add(contrib)
@@ -651,6 +718,7 @@ def expert_ffn_a2a(
     dbo_min_tokens: Optional[int] = None,
     quant: Optional[dict] = None,   # int8 payloads (w_* may be None then)
     interpret: bool = False,        # tests: run the int8 kernel interpreted
+    collective_dtype: Optional[str] = None,  # None -> LLMD_COLLECTIVE_DTYPE
 ) -> jax.Array:
     """Sparse all-to-all EP dispatch (the DeepEP role; see module docstring).
 
@@ -660,8 +728,11 @@ def expert_ffn_a2a(
     otherwise.  With ``quant`` the stacked int8 payloads shard over the
     expert dim and each shard's per-chunk GEMM runs the chunk-streamed
     kernel (``_a2a_moe_chunk``) — the prefill-regime win carries to
-    wide EP.
+    wide EP.  ``collective_dtype`` selects the exchange wire format
+    (bf16 / int8 / int8-dispatch; None resolves LLMD_COLLECTIVE_DTYPE —
+    see parallel/quant_collectives.py).
     """
+    wire = resolve_collective_dtype(collective_dtype)
     ep = mesh.devices.size
     E = quant["w_gate_q"].shape[1] if quant is not None else w_gate.shape[0]
     T = x.shape[0]
@@ -716,7 +787,8 @@ def expert_ffn_a2a(
             sl = slice(ci * chunk_tokens, (ci + 1) * chunk_tokens)
             outs.append(_a2a_moe_chunk(
                 x[sl], weights[sl], idx[sl], w_gate, w_up, w_down,
-                ep, ep_rank, ragged, quant=q_loc, interpret=interpret))
+                ep, ep_rank, ragged, quant=q_loc, interpret=interpret,
+                wire=wire))
         out = jnp.concatenate(outs) if n_chunks > 1 else outs[0]
         # Every shard needs the full hidden state back (attention and the
         # residual stream are replicated in-engine): one bf16 all-gather —
@@ -754,6 +826,7 @@ def expert_ffn(
     dispatch: str = "auto",   # auto | a2a | psum | dense | ragged
     dbo_min_tokens: Optional[int] = None,   # DBO: force >= 2 chunks at this T
     quant: Optional[dict] = None,   # int8 payloads {w_gate_q, w_gate_s, ...}
+    collective_dtype: Optional[str] = None,  # None -> LLMD_COLLECTIVE_DTYPE
 ) -> jax.Array:            # [T, H] in x.dtype
     """Routed-expert FFN, expert-parallel over the flattened mesh.
 
@@ -834,9 +907,16 @@ def expert_ffn(
         quant = None
     if dispatch == "a2a":
         return expert_ffn_a2a(x, weights, idx, w_gate, w_up, w_down, mesh,
-                              dbo_min_tokens=dbo_min_tokens, quant=quant)
+                              dbo_min_tokens=dbo_min_tokens, quant=quant,
+                              collective_dtype=collective_dtype)
 
     sizes = [mesh.shape[a] for a in AXIS_EP]
+    # The psum-oracle allreduce rides the same wire knob: int8 mode swaps
+    # the full-activation f32 psum for the EQuARX-style quantized
+    # allreduce (reduce-scatter + all-gather, both legs int8 + per-row
+    # scales — parallel/quant_collectives.py).  "int8-dispatch" has no
+    # meaning for a reduction and keeps the exact psum.
+    psum_wire = resolve_collective_dtype(collective_dtype)
 
     def shard_body(x, weights, idx, w_gate, w_up, w_down):
         ep_rank = jnp.int32(0)
@@ -844,6 +924,8 @@ def expert_ffn(
             ep_rank = ep_rank * s + jax.lax.axis_index(a)
         out = _local_expert_ffn(
             x, weights, idx, w_gate, w_up, w_down, ep_rank * E_loc)
+        if psum_wire == "int8":
+            return quantized_psum(out, AXIS_EP, ep)
         return jax.lax.psum(out, AXIS_EP)
 
     out = shard_map(

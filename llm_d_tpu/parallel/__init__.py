@@ -1,4 +1,8 @@
 from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh, AXIS_DP, AXIS_EP, AXIS_TP
+from llm_d_tpu.parallel.quant_collectives import (
+    quantized_psum,
+    resolve_collective_dtype,
+)
 from llm_d_tpu.parallel.sharding import (
     ShardingRules,
     logical_to_sharding,
@@ -8,4 +12,5 @@ from llm_d_tpu.parallel.sharding import (
 __all__ = [
     "MeshConfig", "make_mesh", "AXIS_DP", "AXIS_EP", "AXIS_TP",
     "ShardingRules", "logical_to_sharding", "shard_pytree",
+    "quantized_psum", "resolve_collective_dtype",
 ]

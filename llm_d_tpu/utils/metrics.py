@@ -30,6 +30,7 @@ from prometheus_client import (
 # respelling any ``llmd_tpu:*`` name outside this file — consumers import
 # these constants.
 DRAIN_STATE_METRIC = "llmd_tpu:drain_state"
+COLLECTIVE_BYTES_METRIC = "llmd_tpu:collective_bytes_total"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -131,6 +132,18 @@ class EngineMetrics:
             DRAIN_STATE_METRIC,
             "1 while this replica is draining (readiness down, in-flight "
             "completing); the EPP's drain-filter keys on this.")
+        # EP interconnect accounting (round 10, quantized collectives):
+        # wire bytes the MoE dispatch/combine exchanges ship, estimated
+        # from the routed token count at the resolved wire dtype
+        # (parallel/quant_collectives.py is the byte model) — the
+        # dashboard signal that LLMD_COLLECTIVE_DTYPE=int8 actually cut
+        # interconnect traffic, and by how much per phase.
+        self._collective_bytes = Counter(
+            COLLECTIVE_BYTES_METRIC,
+            "EP collective wire bytes shipped (dispatch/combine, "
+            "estimated from routed tokens), by collective and wire "
+            "dtype.",
+            ["model_name", "collective", "dtype"], registry=self.registry)
 
     def observe_queue_wait(self, criticality: str, seconds: float) -> None:
         self._queue_wait.labels(
@@ -140,6 +153,12 @@ class EngineMetrics:
     def inc_deadline_exceeded(self, criticality: str) -> None:
         self._deadline_exceeded.labels(
             model_name=self.model_name, criticality=criticality).inc()
+
+    def add_collective_bytes(self, collective: str, dtype: str,
+                             n: int) -> None:
+        self._collective_bytes.labels(
+            model_name=self.model_name, collective=collective,
+            dtype=dtype).inc(n)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -29,6 +29,12 @@ python -m pytest tests/test_kv_quant.py -q
 # per-absorption accuracy bounds on real traces, latent wire/offload
 # round-trips): the flagship MoE bench serves on this cache.
 python -m pytest tests/test_mla_quant.py -q
+# Quantized EP/TP collective contract fail-fast (round 10: int8
+# dispatch/combine wire + quantized allreduce parity, scale-plane
+# alignment, per-collective accuracy bounds on real routed traces,
+# env-knob fallback): a silent wire-numerics break must not merge.
+python -m pytest tests/test_collective_quant.py -q
 python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py \
-    --ignore=tests/test_mla_quant.py
+    --ignore=tests/test_mla_quant.py \
+    --ignore=tests/test_collective_quant.py

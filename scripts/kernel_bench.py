@@ -27,11 +27,21 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import sys
 import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# The --a2a CPU smoke needs a multi-device mesh; the virtual-device flag
+# must land before JAX initializes its backend (same mechanism as
+# tests/conftest.py).
+if "--a2a" in sys.argv and "--interpret" in sys.argv:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 import jax.numpy as jnp
@@ -326,6 +336,86 @@ def run_mla(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# EP all-to-all sweep (tokens x collective dtype): the quantized-wire
+# crossover table for the wide-EP dispatch/combine (round 10;
+# parallel/quant_collectives.py).  Three wire modes through the REAL
+# ``expert_ffn_a2a`` glue — bf16 both ways, int8 dispatch only, int8 both
+# ways — with the per-token wire-byte accounting alongside so the table
+# shows what each mode ships, not just what it costs.  On CPU
+# (--interpret) the dense all_to_all fallback carries the identical
+# quantized payloads over 8 virtual devices, so tier-1 exercises every
+# exchange (payload, scale plane, expert ids) without a multi-chip slice;
+# timings are flagged invalid there.
+# ---------------------------------------------------------------------------
+
+def run_a2a(args) -> dict:
+    import numpy as np
+    from llm_d_tpu.ops import moe as moe_ops
+    from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
+    from llm_d_tpu.parallel.quant_collectives import ep_a2a_bytes_per_token
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        # A single tunneled chip cannot host an exchange; say so rather
+        # than silently timing the wrong path.
+        return {"mode": "ep_a2a", "backend": jax.default_backend(),
+                "error": f"needs >= 2 devices for the EP mesh, have "
+                         f"{n_dev}; CPU smoke uses --interpret (8 "
+                         f"virtual devices)"}
+    mesh = (make_mesh(MeshConfig(dp=n_dev // 2, sp=1, tp=2))
+            if n_dev % 2 == 0 else make_mesh(MeshConfig(dp=n_dev)))
+    ep = n_dev
+    if args.interpret:
+        E, H, I, k = 8, 64, 32, 2
+        sweep = [16, 32]
+        iters = args.iters or 1
+    else:
+        E, H, I, k = 64, 2048, 512, 8       # deepseek-v3-bench experts
+        sweep = [256, 1024, 4096]
+        iters = args.iters or 10
+    if args.t_sweep:
+        sweep = [int(t) for t in args.t_sweep.split(",") if t]
+    assert E % ep == 0, (E, ep)
+    modes = ("bf16", "int8-dispatch", "int8")
+
+    points = []
+    for i, T in enumerate(sweep):
+        T = max(T, ep) // ep * ep            # a2a needs T % ep == 0
+        rng = np.random.default_rng(i)
+        x = jnp.asarray(rng.standard_normal((T, H)), jnp.bfloat16)
+        w = jnp.abs(jnp.asarray(rng.standard_normal((T, k)),
+                                jnp.float32)) * 0.3
+        idx = jnp.asarray(rng.integers(0, E, (T, k)), jnp.int32)
+        wg = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.bfloat16)
+        wu = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.bfloat16)
+        wd = jnp.asarray(rng.standard_normal((E, I, H)) * 0.2, jnp.bfloat16)
+        ms = {}
+        for mode in modes:
+            ms[mode] = round(_time_ms(
+                lambda mode=mode: moe_ops.expert_ffn_a2a(
+                    x, w, idx, wg, wu, wd, mesh, collective_dtype=mode),
+                iters), 3)
+        points.append({
+            "T": T, "ms": ms,
+            # What each mode actually ships per token per MoE layer
+            # (dispatch + combine + index plane; "f32-combine" = the
+            # pre-round-10 wire, the acceptance baseline).
+            "wire_bytes_per_token_layer": {
+                m: ep_a2a_bytes_per_token(H, k, m)
+                for m in modes + ("f32-combine",)},
+        })
+    return {
+        "mode": "ep_a2a",
+        "backend": jax.default_backend(),
+        "interpret": args.interpret,
+        "timings_valid": not args.interpret,
+        "shapes": {"E": E, "H": H, "I": I, "k": k, "ep": ep},
+        "iters": iters,
+        "points": points,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interpret", action="store_true",
@@ -340,6 +430,13 @@ def main(argv=None) -> int:
                     help="run the MLA decode context x latent-dtype sweep "
                          "(bf16 vs int8 latent cache) instead of the MoE "
                          "kernel family")
+    ap.add_argument("--a2a", action="store_true",
+                    help="run the EP all-to-all tokens x collective-dtype "
+                         "sweep (bf16 / int8 dispatch-only / int8 both "
+                         "ways) through the real expert_ffn_a2a glue "
+                         "instead of the MoE kernel family; needs a "
+                         "multi-device mesh (--interpret forces 8 "
+                         "virtual CPU devices)")
     ap.add_argument("--ctx-sweep", type=str, default=None,
                     help="paged/mla mode: comma-separated context lengths "
                          "(default: 256..4096 on chip, 64,128 interpreted)")
@@ -359,14 +456,20 @@ def main(argv=None) -> int:
                     help="also write the JSON document to this path")
     args = ap.parse_args(argv)
 
-    if args.paged or args.mla:
-        doc = run_paged(args) if args.paged else run_mla(args)
+    if args.paged or args.mla or args.a2a:
+        doc = (run_paged(args) if args.paged
+               else run_mla(args) if args.mla else run_a2a(args))
         text = json.dumps(doc)
         print(text)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(text + "\n")
-        return 0
+        # A mode that could not run (e.g. --a2a without a multi-device
+        # mesh — a programmatic caller that imported this module after
+        # JAX initialized misses the sys.argv device bootstrap above)
+        # must fail loudly, not hand an error document to a harness
+        # that only checks the exit code.
+        return 1 if "error" in doc else 0
 
     if args.interpret:
         E, H, I, k = 8, 256, 128, 2

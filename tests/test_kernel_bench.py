@@ -95,6 +95,34 @@ def test_kernel_bench_mla_sweep_interpret(tmp_path, capsys):
     assert "LLMD_MLA_LATENT_DTYPE" in doc["crossover"]
 
 
+def test_kernel_bench_a2a_sweep_interpret(tmp_path, capsys):
+    """--a2a: the tokens x collective-dtype EP exchange sweep runs all
+    three wire modes (bf16 / int8 dispatch-only / int8 both ways)
+    through the REAL expert_ffn_a2a glue on the 8-device CPU mesh, with
+    the per-mode wire-byte accounting alongside."""
+    mod = _kernel_bench()
+    out = tmp_path / "a2a.json"
+    rc = mod.main(["--a2a", "--interpret", "--t-sweep", "16,32",
+                   "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc == json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["mode"] == "ep_a2a"
+    assert doc["timings_valid"] is False
+    assert doc["shapes"]["ep"] == 8
+    assert [p["T"] for p in doc["points"]] == [16, 32]
+    for p in doc["points"]:
+        for mode in ("bf16", "int8-dispatch", "int8"):
+            assert isinstance(p["ms"][mode], float) and p["ms"][mode] > 0
+        # The byte accounting the sweep exists to show (at this tiny
+        # H=64 the per-row scale+index overhead is at its relative
+        # worst; the 0.35x acceptance ratio at serving hidden sizes is
+        # pinned in test_collective_quant.py).
+        b = p["wire_bytes_per_token_layer"]
+        assert b["int8"] < 0.5 * b["f32-combine"]
+        assert b["int8-dispatch"] < b["bf16"] < b["f32-combine"]
+
+
 def test_kernel_bench_respects_path_caps(tmp_path):
     """--dense-max-t / --routed-max-t null out the capped paths (the
     shapes a real chip cannot run) and the recommendation still derives
