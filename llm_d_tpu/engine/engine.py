@@ -1009,8 +1009,9 @@ class EngineCore:
         # Chaos fault point: simulated engine death (a raised fault
         # propagates exactly like a real step crash — AsyncEngine marks
         # the engine dead, fails all streams, /health turns 500).  No-op
-        # dict miss unless rules are installed.
-        get_injector().check("engine.step")
+        # dict miss unless rules are installed.  Keyed by model name so a
+        # multi-engine chaos harness can kill one replica via match=.
+        get_injector().check("engine.step", key=str(self.config.model))
         outputs: List[RequestOutput] = []
         if self._rejected:
             outputs.extend(self._rejected)

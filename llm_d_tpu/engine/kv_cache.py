@@ -152,7 +152,7 @@ class KVCacheManager:
         best_r = most_free
         if chain_region is not None and chain_len > 0:
             fresh_needed = max(
-                0, -(-request.num_prompt_tokens // self.block_size)
+                0, -(-request.num_tokens // self.block_size)
                 - chain_len)
             if self.region_free_blocks(chain_region) >= fresh_needed:
                 best_r = chain_region
@@ -192,12 +192,15 @@ class KVCacheManager:
             if b is None:
                 break
             blocks.append(b)
-        # Never mark the whole prompt computed: the final token must be
-        # (re)computed to produce logits for sampling.
-        max_cacheable = (request.num_prompt_tokens - 1) // self.block_size
+        # Never mark the whole sequence computed: the final token must be
+        # (re)computed to produce logits for sampling.  num_tokens (not
+        # num_prompt_tokens) so a RESUME admission — output_token_ids
+        # pre-populated from the relay journal — restores through the
+        # generated region too; for fresh requests the two are equal.
+        max_cacheable = (request.num_tokens - 1) // self.block_size
         blocks = blocks[:max_cacheable + 1]
         n = len(blocks) * self.block_size
-        if n >= request.num_prompt_tokens:
+        if n >= request.num_tokens:
             blocks = blocks[:max_cacheable]
             n = len(blocks) * self.block_size
         return blocks, n

@@ -332,6 +332,16 @@ class HostKVTier:
         blocks: they sit refcount-0 in the evictor and MUST NOT be chosen
         as the restore target (overwriting one mid-lookup would silently
         corrupt the very prefix being assembled)."""
+        try:
+            # Chaos fault point: tier restore failure (e.g. during a
+            # mid-stream resume admission).  A fired fault IS a miss —
+            # the caller falls through to recompute, exactly the path a
+            # corrupted/unreachable tier would take.
+            get_injector().check("kv.restore", key=block_hash.hex()[:16])
+        except FaultInjected as exc:
+            logger.warning("kv.restore fault: treating tier restore as a "
+                           "miss (%s)", exc)
+            return None
         blob = self._store.get(block_hash)
         if blob is None and self.peers:
             blob = self._fetch_from_peers(block_hash)

@@ -72,6 +72,18 @@ class Request:
     do_remote_prefill: bool = False    # consumer side: pull KV before decode
     do_remote_decode: bool = False     # producer side: stop after prefill
 
+    # --- mid-stream resume (journaled decode failover) ---
+    # A resumed request arrives with output_token_ids PRE-POPULATED from
+    # the relay's journal: the first resume_offset completion tokens were
+    # already delivered by a dead replica.  The scheduler admits
+    # prompt+generated as a prefill (restore-first from the prefix cache
+    # / host tier, recompute on miss) and the server emits tokens from
+    # resume_offset on.  resume_restored_tokens records how many
+    # GENERATED-region tokens the cache tiers satisfied at admission
+    # (the restored-vs-recomputed outcome signal).
+    resume_offset: int = 0
+    resume_restored_tokens: int = 0
+
     @property
     def slo_tier(self) -> int:
         """Criticality as a priority tier (critical=-1 < standard=0 <

@@ -240,7 +240,14 @@ class Scheduler:
                     # last prompt token is computed locally.
                     reuse, n_cached = [], 0
                 req.num_computed_tokens = n_cached
-                req.num_cached_prompt_tokens = n_cached
+                # Metrics see prompt-region hits only; a resume admission
+                # may restore past the prompt into the generated region —
+                # that surplus is the restored-vs-recomputed signal.
+                req.num_cached_prompt_tokens = min(
+                    n_cached, req.num_prompt_tokens)
+                if req.resume_offset:
+                    req.resume_restored_tokens = max(
+                        0, n_cached - req.num_prompt_tokens)
             remaining = req.num_tokens - req.num_computed_tokens
             n = min(remaining, budget)
             if n <= 0:

@@ -35,16 +35,20 @@ from llm_d_tpu.epp.datastore import Datastore, EndpointBreaker, EndpointState
 from llm_d_tpu.epp.indexer import PrefixIndex, ZmqEventSubscriber
 from llm_d_tpu.epp.plugins import RequestCtx
 from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
+from llm_d_tpu.server import stream_resume
+from llm_d_tpu.server.stream_resume import StreamJournal
 from llm_d_tpu.utils.config import env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 from llm_d_tpu.utils.lifecycle import (
     CRITICALITY_HEADER,
+    CRITICALITY_SHEDDABLE,
     DEADLINE_ABS_HEADER,
     DEADLINE_EXCEEDED_HEADER,
     RETRY_ATTEMPT_HEADER,
     RETRY_BUDGET_HEADER,
     parse_criticality,
     parse_deadline,
+    remaining_s,
 )
 from llm_d_tpu.utils.metrics import EppMetrics
 
@@ -214,7 +218,6 @@ class Gateway:
         if self.flow is None:
             return await self._schedule_and_forward(
                 body, request, criticality, deadline_epoch)
-        from llm_d_tpu.utils.lifecycle import remaining_s
         outcome = await self.flow.acquire(
             sheddable=priority < 0 or criticality == "sheddable",
             criticality=criticality,
@@ -265,8 +268,12 @@ class Gateway:
         """Schedule, forward, and on connect-failure/5xx RE-SCHEDULE on the
         surviving replicas (bounded attempts; failed endpoints are excluded
         from the retry's candidate set and recorded against their circuit
-        breaker).  Only failures with NO response bytes committed retry —
-        a half-sent stream can't be replayed."""
+        breaker).  Only failures with NO response bytes committed take this
+        retry path; a half-sent SSE stream is RESUMED instead — the relay
+        journals emitted tokens and, on mid-stream death (upstream break,
+        or a stall past the token-gap watchdog), re-schedules on the
+        surviving replicas and splices the continuation at the journal
+        offset (:mod:`llm_d_tpu.server.stream_resume`)."""
         breaker = self.datastore.breaker
         metrics = self.scheduler.metrics
         max_attempts = 1 + max(0, self.retry_attempts)
@@ -274,6 +281,12 @@ class Gateway:
         rid = ""
         last_error = "no ready endpoints"
         attempts_made = 0          # forwards actually sent (error reporting)
+        policy = stream_resume.resume_policy()
+        journal: Optional[StreamJournal] = None
+        if policy.enabled and bool(body.get("stream", False)) \
+                and criticality != CRITICALITY_SHEDDABLE:
+            journal = StreamJournal(body, criticality=criticality,
+                                    deadline_epoch=deadline_epoch)
 
         def note_retry(addr: str, reason: str, error: str) -> None:
             """Shared retry bookkeeping: breaker, exclusion, metric, log."""
@@ -378,16 +391,30 @@ class Gateway:
                     resp.headers[RETRY_BUDGET_HEADER] = \
                         f"{attempt}/{max_attempts - 1}"
                     await resp.prepare(request)
-                    async for chunk in upstream.content.iter_any():
-                        await resp.write(chunk)
+                    if journal is not None and upstream.status == 200:
+                        await stream_resume.relay_stream(
+                            resp, upstream.content, journal,
+                            fault_key=primary.address,
+                            stall_timeout_s=policy.stall_timeout_s)
+                    else:
+                        async for chunk in upstream.content.iter_any():
+                            await resp.write(chunk)
                     await resp.write_eof()
                     return resp
-            except (aiohttp.ClientError, FaultInjected) as exc:
+            except (aiohttp.ClientError, FaultInjected,
+                    stream_resume.StreamBroken) as exc:
                 if resp is not None:
                     # Headers already went out: a second (json) response
-                    # would corrupt the half-sent stream — close it
-                    # truncated (and count the endpoint's failure).
+                    # would corrupt the half-sent stream.  A journaled
+                    # stream is RESUMED on a surviving replica; anything
+                    # else closes truncated (today's contract), counting
+                    # the endpoint's failure either way.
                     breaker.record_failure(primary.address)
+                    if journal is not None and resp.status == 200:
+                        return await self._resume_stream(
+                            request, resp, journal, policy,
+                            excluded | {primary.address}, criticality,
+                            deadline_epoch, exc)
                     return resp
                 if attempt + 1 < max_attempts:
                     note_retry(primary.address, "connect",
@@ -405,6 +432,126 @@ class Gateway:
                  "attempts": attempts_made}, status=502)
         return web.json_response(
             {"error": "no ready endpoints", "request_id": rid}, status=503)
+
+    def _drain_recoveries(self, journal: StreamJournal) -> None:
+        metrics = self.scheduler.metrics
+        for outcome, secs in journal.take_recoveries():
+            metrics.stream_resume.labels(outcome=outcome).inc()
+            metrics.request_recovery.observe(secs)
+
+    async def _resume_stream(self, request: web.Request,
+                             resp: web.StreamResponse,
+                             journal: StreamJournal, policy,
+                             excluded: set, criticality: str,
+                             deadline_epoch: Optional[float],
+                             first_exc: BaseException) -> web.StreamResponse:
+        """Mid-stream decode failover: re-schedule the broken stream on
+        the surviving replicas (dead endpoints excluded, breaker-aware)
+        and splice the continuation into the client's still-open SSE
+        response at the journal's token offset.
+
+        Degradation ladder: attempts beyond ``LLMD_RESUME_MAX_ATTEMPTS``,
+        an exhausted deadline budget, or no surviving candidate end the
+        recovery — the stream closes truncated exactly as it does today,
+        with ``llmd_tpu:stream_resume_total{outcome="failed"}`` marking
+        the loss."""
+        breaker = self.datastore.breaker
+        metrics = self.scheduler.metrics
+        excluded = set(excluded)
+        exc: BaseException = first_exc
+        while True:
+            if journal.finish_reason and not journal.done:
+                # The finish chunk was already delivered — only [DONE]
+                # was lost in the break.  The stream is logically
+                # complete: close it here, no replica needed (resuming
+                # would decode past the delivered EOS/stop).
+                journal.done = True
+                try:
+                    await resp.write(b"data: [DONE]\n\n")
+                    await resp.write_eof()
+                except (ConnectionResetError, OSError):
+                    pass
+                return resp
+            left = remaining_s(deadline_epoch)
+            if not journal.resumable \
+                    or journal.resume_count >= policy.max_attempts \
+                    or (left is not None and left <= 0):
+                metrics.stream_resume.labels(
+                    outcome=stream_resume.OUTCOME_FAILED).inc()
+                logger.error(
+                    "stream %s broke at token %d and was NOT recovered "
+                    "(%s; attempts=%d/%d, budget_left=%s)",
+                    journal.stream_id or "-", journal.offset, exc,
+                    journal.resume_count, policy.max_attempts,
+                    "none" if left is None else f"{left:.2f}s")
+                return resp               # truncated: today's contract
+            journal.resume_count += 1
+            journal.mark_break()
+            try:
+                ctx = self._make_ctx(journal.body, request)
+            except (TypeError, ValueError):
+                metrics.stream_resume.labels(
+                    outcome=stream_resume.OUTCOME_FAILED).inc()
+                return resp
+            ctx.excluded_endpoints = set(excluded)
+            ctx.retry_attempt = journal.resume_count
+            result = await asyncio.to_thread(self.scheduler.schedule, ctx)
+            primary = result.primary
+            if primary is None:
+                metrics.stream_resume.labels(
+                    outcome=stream_resume.OUTCOME_FAILED).inc()
+                logger.error(
+                    "stream %s: no surviving resume target (excluded=%s)",
+                    journal.stream_id or "-", sorted(excluded))
+                return resp
+            fwd_headers = {k: v for k, v in result.headers.items()
+                           if k != DESTINATION_HEADER}
+            fwd_headers.update(journal.resume_headers())
+            fwd_headers[CRITICALITY_HEADER] = criticality
+            if deadline_epoch is not None:
+                fwd_headers[DEADLINE_ABS_HEADER] = f"{deadline_epoch:.6f}"
+            logger.warning(
+                "stream %s broke at token %d (%s); resuming on %s "
+                "(attempt %d/%d)", journal.stream_id or "-",
+                journal.offset, exc, primary.address,
+                journal.resume_count, policy.max_attempts)
+            metrics.gateway_retries.labels(reason="resume").inc()
+            try:
+                await get_injector().acheck("gateway.forward",
+                                            key=primary.address)
+                async with self._session.post(
+                        f"{primary.url}{request.path}",
+                        json=journal.resume_body(), headers=fwd_headers,
+                        timeout=aiohttp.ClientTimeout(
+                            total=None, sock_connect=10)) as upstream:
+                    if upstream.status != 200:
+                        breaker.record_failure(primary.address)
+                        excluded.add(primary.address)
+                        exc = RuntimeError(
+                            f"resume target {primary.address} answered "
+                            f"HTTP {upstream.status}")
+                        continue
+                    await stream_resume.relay_stream(
+                        resp, upstream.content, journal,
+                        fault_key=primary.address,
+                        stall_timeout_s=policy.stall_timeout_s)
+            except (aiohttp.ClientError, FaultInjected,
+                    stream_resume.StreamBroken) as e:
+                # The resume target died too (possibly after partial
+                # progress — already journaled and accounted): exclude
+                # it and go around.
+                breaker.record_failure(primary.address)
+                excluded.add(primary.address)
+                self._drain_recoveries(journal)
+                exc = e
+                continue
+            breaker.record_success(primary.address)
+            self._drain_recoveries(journal)
+            try:
+                await resp.write_eof()
+            except (ConnectionResetError, OSError):
+                pass            # client gone after the final frame
+            return resp
 
     def _make_ctx(self, body: Dict, request: web.Request) -> RequestCtx:
         return RequestCtx.from_request(

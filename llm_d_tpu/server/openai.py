@@ -32,15 +32,21 @@ from aiohttp import web
 
 from llm_d_tpu.engine.async_engine import AsyncEngine
 from llm_d_tpu.engine.engine import EngineConfig, EngineCore
-from llm_d_tpu.engine.request import Request
+from llm_d_tpu.engine.request import Request, RequestOutput
 from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.server import stream_resume
+from llm_d_tpu.server.stream_resume import StreamJournal
 from llm_d_tpu.utils.config import env_float, env_int
+from llm_d_tpu.utils.faultinject import FaultInjected
 from llm_d_tpu.utils.lifecycle import (
+    CRITICALITY_SHEDDABLE,
     DEADLINE_EXCEEDED_HEADER,
     DRAINING_HEADER,
+    RESUME_OFFSET_HEADER,
     SCHED_DEPTH_HEADER,
     parse_criticality,
     parse_deadline,
+    remaining_s,
 )
 from llm_d_tpu.utils.tokenizer import get_tokenizer
 
@@ -135,33 +141,160 @@ class DPWorkerPool:
         best = min(live, key=self.load)
         return best if self.load(best) < local else None
 
+    # Hop-by-hop headers: forward end-to-end headers both ways (auth,
+    # tracing, accept — proxied and locally-served requests must be
+    # indistinguishable to clients and gateways); these stay per-hop.
+    _HOP = {"host", "content-length", "transfer-encoding", "connection",
+            "keep-alive", "upgrade", "te", "trailer",
+            "proxy-authorization", "proxy-authenticate"}
+
+    def alternates(self, dead: set) -> Optional[dict]:
+        """Least-loaded live worker outside ``dead`` (resume targets)."""
+        now = time.monotonic()
+        live = [w for w in self.workers
+                if w["down_until"] <= now and w["url"] not in dead]
+        return min(live, key=self.load) if live else None
+
     async def proxy(self, request: web.Request, body: Dict[str, Any],
-                    worker: dict) -> Optional[web.StreamResponse]:
+                    worker: dict,
+                    server=None) -> Optional[web.StreamResponse]:
         """Stream-through proxy of one inference request to a worker.
 
         Returns None when the worker was unreachable BEFORE any response
-        bytes were committed — the caller falls back to serving locally
-        (mid-stream failures must propagate: bytes already left)."""
+        bytes were committed — the caller falls back to serving locally.
+
+        Mid-stream death of the worker is recoverable for journaled SSE
+        streams (``LLMD_STREAM_RESUME``): the relay journals emitted
+        token ids, and on an upstream break resumes the stream on the
+        least-loaded surviving worker — or on the LOCAL engine via
+        ``server`` — deduping by token offset, so the client stream
+        continues without duplicate or missing tokens.  Worker-slot
+        accounting is settled per attempt: the dead worker's streaming
+        self-count is released when its attempt ends, and the resume
+        target's exchange counts itself exactly once (the depth-report
+        contract — no phantom load on either side)."""
         import aiohttp
         if self._session is None:
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=None, sock_connect=5))
+        policy = stream_resume.resume_policy()
+        journal = None
+        if policy.enabled and bool(body.get("stream", False)):
+            in_headers = {k.lower(): v for k, v in request.headers.items()}
+            try:
+                criticality = parse_criticality(in_headers, body)
+            except ValueError:
+                criticality = "standard"
+            try:
+                deadline_epoch = parse_deadline(in_headers, body)
+            except ValueError:
+                deadline_epoch = None
+            if criticality != CRITICALITY_SHEDDABLE:
+                journal = StreamJournal(body, criticality=criticality,
+                                        deadline_epoch=deadline_epoch)
+        resp: Optional[web.StreamResponse] = None
+        current: Optional[dict] = worker
+        dead: set = set()
+        while True:
+            send_body = body
+            extra_headers: Dict[str, str] = {}
+            if journal is not None and journal.resume_count:
+                send_body = journal.resume_body()
+                extra_headers = journal.resume_headers()
+            resp, broke_exc = await self._attempt(
+                request, send_body, extra_headers, current, journal,
+                resp, policy)
+            self._settle_recoveries(journal, server)
+            if broke_exc is None:
+                return resp          # relayed to completion (or None:
+            #                          nothing committed, caller serves
+            #                          locally)
+            dead.add(current["url"])
+            if journal.finish_reason and not journal.done:
+                # Finish chunk already delivered; only [DONE] was lost —
+                # close the stream locally (resuming would decode past
+                # the delivered EOS/stop).
+                journal.done = True
+                try:
+                    await resp.write(b"data: [DONE]\n\n")
+                    await resp.write_eof()
+                except (ConnectionResetError, OSError):
+                    pass
+                return resp
+            if not journal.resumable \
+                    or journal.resume_count >= policy.max_attempts \
+                    or self._budget_gone(journal):
+                # Degraded to today's contract: re-raise so the client
+                # connection closes ABRUPTLY (a clean EOF would make the
+                # truncation invisible to plain SSE clients).
+                if server is not None:
+                    server.engine.metrics.inc_stream_resume(
+                        stream_resume.OUTCOME_FAILED)
+                raise broke_exc
+            journal.resume_count += 1
+            journal.mark_break()
+            target = self.alternates(dead)
+            if target is None and server is not None:
+                # Every worker host is down: the leader's own engine is
+                # the last resume target.
+                ok = await server.resume_local(request, resp, journal)
+                self._settle_recoveries(journal, server)
+                if not journal.done:
+                    server.engine.metrics.inc_stream_resume(
+                        stream_resume.OUTCOME_FAILED)
+                    if not ok:
+                        raise broke_exc
+                return resp
+            if target is None:
+                if server is not None:
+                    server.engine.metrics.inc_stream_resume(
+                        stream_resume.OUTCOME_FAILED)
+                raise broke_exc
+            logger.warning(
+                "DP worker %s died mid-stream at token %d; resuming on "
+                "%s (attempt %d/%d)", current["url"], journal.offset,
+                target["url"], journal.resume_count, policy.max_attempts)
+            current = target
+
+    def _budget_gone(self, journal: StreamJournal) -> bool:
+        left = remaining_s(journal.deadline_epoch)
+        return left is not None and left <= 0
+
+    @staticmethod
+    def _settle_recoveries(journal: Optional[StreamJournal],
+                           server) -> None:
+        """Drain completed (outcome, seconds) recovery pairs into the
+        leader's metrics (the EPP gateway's _drain_recoveries twin)."""
+        if journal is None or server is None:
+            return
+        for outcome, secs in journal.take_recoveries():
+            server.engine.metrics.inc_stream_resume(outcome)
+            server.engine.metrics.request_recovery.observe(secs)
+
+    async def _attempt(self, request: web.Request, body: Dict[str, Any],
+                       extra_headers: Dict[str, str], worker: dict,
+                       journal: Optional[StreamJournal],
+                       resp: Optional[web.StreamResponse],
+                       policy) -> tuple:
+        """One forward to one worker with per-worker load accounting.
+
+        Returns (resp, exc): ``exc`` non-None means the stream died
+        mid-relay after bytes were committed (resumable — or re-raised
+        by the caller when recovery is off the table, so the client sees
+        the abrupt break today's contract promises); ``resp`` None with
+        ``exc`` None means nothing was committed (the caller serves
+        locally)."""
+        import aiohttp
         worker["inflight"] += 1
         seq = worker["seq"]
         worker["seq"] += 1
         worker["dispatching"].add(seq)
         headers_seen = False
         counted_self = False
-        resp = None
-        # Forward end-to-end headers both ways (auth, tracing, accept —
-        # proxied and locally-served requests must be indistinguishable
-        # to clients and gateways); hop-by-hop headers stay per-hop.
-        hop = {"host", "content-length", "transfer-encoding", "connection",
-               "keep-alive", "upgrade", "te", "trailer",
-               "proxy-authorization", "proxy-authenticate"}
         fwd_headers = {k: v for k, v in request.headers.items()
-                       if k.lower() not in hop
+                       if k.lower() not in self._HOP
                        and k.lower() != "content-type"}  # json= sets it
+        fwd_headers.update(extra_headers)
         try:
             async with self._session.post(
                     worker["url"] + request.path_qs, json=body,
@@ -179,7 +312,10 @@ class DPWorkerPool:
                 # the worker's scheduler, so take it back out — otherwise
                 # a finished stream leaves the worker looking loaded
                 # until the next report.  Non-streaming reports leave at
-                # completion and already exclude themselves.
+                # completion and already exclude themselves.  A resumed
+                # stream settles each attempt's worker here, so the dead
+                # endpoint's slot is released and the stream counts
+                # exactly once, on the worker currently serving it.
                 counted_self = upstream.headers.get(
                     "Content-Type", "").startswith("text/event-stream")
                 if depth is not None:
@@ -187,22 +323,50 @@ class DPWorkerPool:
                         worker["depth"] = max(0, int(depth))
                     except ValueError:
                         pass
-                resp = web.StreamResponse(
-                    status=upstream.status,
-                    headers={k: v for k, v in upstream.headers.items()
-                             if k.lower() not in hop})
-                await resp.prepare(request)
-                async for chunk in upstream.content.iter_any():
-                    await resp.write(chunk)
-                await resp.write_eof()
-                return resp
-        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+                if not counted_self:
+                    # Non-SSE exchange (error body, non-streaming
+                    # request): legacy verbatim relay — journaling and
+                    # resume only apply to committed SSE streams.
+                    journal = None
+                if resp is not None and (upstream.status != 200
+                                         or not counted_self):
+                    # Resume refused (draining/dead-on-arrival replica):
+                    # treat as a mid-stream failure of this worker.
+                    logger.warning("DP resume on %s refused: HTTP %d",
+                                   worker["url"], upstream.status)
+                    return resp, RuntimeError(
+                        f"resume target {worker['url']} refused: "
+                        f"HTTP {upstream.status}")
+                if resp is None:
+                    resp = web.StreamResponse(
+                        status=upstream.status,
+                        headers={k: v for k, v in upstream.headers.items()
+                                 if k.lower() not in self._HOP})
+                    await resp.prepare(request)
+                if journal is None:
+                    async for chunk in upstream.content.iter_any():
+                        await resp.write(chunk)
+                else:
+                    await stream_resume.relay_stream(
+                        resp, upstream.content, journal,
+                        fault_key=worker["url"],
+                        stall_timeout_s=policy.stall_timeout_s)
+                try:
+                    await resp.write_eof()
+                except (ConnectionResetError, OSError):
+                    pass        # client gone after the final frame
+                return resp, None
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                FaultInjected, stream_resume.StreamBroken) as exc:
             worker["down_until"] = time.monotonic() + self.worker_backoff_s
             logger.warning("DP worker %s unreachable (%s); backing off %.0fs",
                            worker["url"], exc, self.worker_backoff_s)
             if resp is None:
-                return None          # nothing committed: serve locally
-            raise                    # mid-stream: the client sees the break
+                return None, None    # nothing committed: serve locally
+            if journal is None:
+                raise                # unjournaled mid-stream: today's
+            #                          fail-fast — the client sees the break
+            return resp, exc         # mid-stream break (resumable)
         finally:
             worker["inflight"] -= 1
             if not headers_seen:
@@ -381,6 +545,25 @@ class ModelServer:
 
     # ---------- inference ----------
 
+    def _prompt_ids(self, body: Dict[str, Any], chat: bool) -> List[int]:
+        """Prompt token ids for either endpoint schema (one derivation
+        for the first serve AND a mid-stream resume — the resumed
+        prefill must hash to the same prefix-cache chain)."""
+        if chat:
+            messages = body.get("messages", [])
+            if hasattr(self.tokenizer, "_tok") and hasattr(
+                    self.tokenizer._tok, "apply_chat_template"):
+                return self.tokenizer._tok.apply_chat_template(
+                    messages, add_generation_prompt=True)
+            text = "".join(
+                f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
+                for m in messages) + "<|assistant|>"
+            return self.tokenizer.encode(text)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            return prompt
+        return self.tokenizer.encode(str(prompt))
+
     def _make_request(self, body: Dict[str, Any], prompt_ids: List[int],
                       headers: Optional[Dict[str, str]] = None) -> Request:
         rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
@@ -410,6 +593,27 @@ class ModelServer:
             elif ktp.get("remote_block_ids") or ktp.get("do_remote_prefill"):
                 req.do_remote_prefill = True
                 req.kv_transfer_params = ktp
+        resume = body.get("resume")
+        if resume:
+            # Mid-stream resume admission: the relay journal's emitted
+            # token ids arrive pre-generated.  The scheduler admits
+            # prompt+generated as a prefill (restore-first from the
+            # prefix cache / host tier, recompute on miss) and decode
+            # continues from the journal offset.
+            try:
+                ids = [int(t) for t in (resume.get("token_ids") or [])]
+            except (TypeError, ValueError) as e:
+                raise ValueError("invalid resume.token_ids") from e
+            off_hdr = headers.get(RESUME_OFFSET_HEADER)
+            if off_hdr is not None and int(off_hdr) != len(ids):
+                raise ValueError(
+                    f"resume offset {off_hdr} != {len(ids)} journaled "
+                    f"token ids")
+            if req.do_remote_prefill or req.do_remote_decode:
+                raise ValueError("resume cannot combine with PD "
+                                 "kv_transfer_params roles")
+            req.output_token_ids = ids
+            req.resume_offset = len(ids)
         return req
 
     def _refuse_draining(self) -> Optional[web.Response]:
@@ -432,15 +636,13 @@ class ModelServer:
         if self.dp_pool is not None:
             worker = self.dp_pool.pick(self.engine)
             if worker is not None:
-                proxied = await self.dp_pool.proxy(request, body, worker)
+                proxied = await self.dp_pool.proxy(request, body, worker,
+                                                   server=self)
                 if proxied is not None:
                     return proxied
-        prompt = body.get("prompt", "")
-        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
-            prompt_ids = prompt
-        else:
-            prompt_ids = self.tokenizer.encode(str(prompt))
-        return await self._run(request, body, prompt_ids, chat=False)
+        return await self._run(request, body,
+                               self._prompt_ids(body, chat=False),
+                               chat=False)
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -453,20 +655,13 @@ class ModelServer:
         if self.dp_pool is not None:
             worker = self.dp_pool.pick(self.engine)
             if worker is not None:
-                proxied = await self.dp_pool.proxy(request, body, worker)
+                proxied = await self.dp_pool.proxy(request, body, worker,
+                                                   server=self)
                 if proxied is not None:
                     return proxied
-        messages = body.get("messages", [])
-        if hasattr(self.tokenizer, "_tok") and hasattr(
-                self.tokenizer._tok, "apply_chat_template"):
-            prompt_ids = self.tokenizer._tok.apply_chat_template(
-                messages, add_generation_prompt=True)
-        else:
-            text = "".join(
-                f"<|{m.get('role', 'user')}|>{m.get('content', '')}" for m in messages
-            ) + "<|assistant|>"
-            prompt_ids = self.tokenizer.encode(text)
-        return await self._run(request, body, prompt_ids, chat=True)
+        return await self._run(request, body,
+                               self._prompt_ids(body, chat=True),
+                               chat=True)
 
     def _usage(self, req: Request, body: Dict[str, Any]) -> Dict[str, Any]:
         """Usage block incl. latency actuals (+ gateway predictions when
@@ -576,35 +771,7 @@ class ModelServer:
                 "Cache-Control": "no-cache",
                 DPWorkerPool.DEPTH_HEADER: str(self._sched_depth() + 1)})
             await resp.prepare(http_req)
-            all_text_len = 0
-            async for out in self.async_engine.generate(req):
-                text = self.tokenizer.decode(req.output_token_ids)
-                delta, all_text_len = text[all_text_len:], len(text)
-                delta, stopped = self._apply_stop_strings(req, delta, text)
-                finished = out.finished or stopped
-                reason = "stop" if stopped else out.finish_reason
-                chunk = self._chunk(req, delta, out, created, chat,
-                                    finished=finished, finish_reason=reason)
-                await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
-                if stopped and not out.finished:
-                    # Safety net: the engine missed the stop string (e.g. it
-                    # spanned a longer window); terminate and settle accounts.
-                    self.engine.abort_request(req.request_id)
-                    break
-                if finished:
-                    break
-            if bool((body.get("stream_options") or {}).get("include_usage")):
-                usage_chunk = {
-                    "id": req.request_id,
-                    "object": "chat.completion.chunk" if chat
-                    else "text_completion",
-                    "created": created, "model": self.model_name,
-                    "choices": [],
-                    "usage": self._usage(req, body),
-                }
-                await resp.write(b"data: "
-                                 + json.dumps(usage_chunk).encode() + b"\n\n")
-            await resp.write(b"data: [DONE]\n\n")
+            await self._stream_tokens_into(resp, req, body, chat, created)
             await resp.write_eof()
             self._post_training_sample(req, arrival_feats)
             return resp
@@ -682,6 +849,124 @@ class ModelServer:
             headers[DEADLINE_EXCEEDED_HEADER] = "1"
         return web.json_response(payload, headers=headers)
 
+    async def _stream_tokens_into(self, resp: web.StreamResponse,
+                                  req: Request, body: Dict[str, Any],
+                                  chat: bool, created: int,
+                                  journal: Optional[StreamJournal] = None
+                                  ) -> None:
+        """Generate and write the SSE token stream for one (possibly
+        resumed) request into an already-prepared response.
+
+        A resumed request starts its text delta after the restored
+        prefix (the relay already delivered those tokens) and stamps the
+        first chunk's ``llmd`` meta with the restore-vs-recompute
+        verdict.  ``journal`` (DP-leader local resume) mirrors every
+        frame through the relay journal so offset dedupe and recovery
+        accounting work exactly as for a proxied resume."""
+        async def write_frame(payload: Dict[str, Any]) -> None:
+            frame = b"data: " + json.dumps(payload).encode() + b"\n\n"
+            if journal is None or journal.admit_frame(frame):
+                await resp.write(frame)
+
+        if req.resume_offset >= req.sampling.max_tokens:
+            # The break landed between the last token and [DONE]: every
+            # token was already delivered — emit the finish frame (and
+            # the usage/[DONE] tail below) without decoding an extra one.
+            await write_frame(self._chunk(
+                req, "", RequestOutput(req.request_id, [], True, "length"),
+                created, chat, finished=True, finish_reason="length"))
+        else:
+            await self._generate_stream(req, chat, created, write_frame)
+        if bool((body.get("stream_options") or {}).get("include_usage")):
+            await write_frame({
+                "id": req.request_id,
+                "object": "chat.completion.chunk" if chat
+                else "text_completion",
+                "created": created, "model": self.model_name,
+                "choices": [],
+                "usage": self._usage(req, body),
+            })
+        done = b"data: [DONE]\n\n"
+        if journal is not None:
+            journal.admit_frame(done)
+        await resp.write(done)
+
+    async def _generate_stream(self, req: Request, chat: bool,
+                               created: int, write_frame) -> None:
+        """The token-generation loop of :meth:`_stream_tokens_into`."""
+        all_text_len = 0
+        if req.resume_offset:
+            all_text_len = len(self.tokenizer.decode(req.output_token_ids))
+        first_meta_pending = req.resume_offset > 0
+        async for out in self.async_engine.generate(req):
+            text = self.tokenizer.decode(req.output_token_ids)
+            delta, all_text_len = text[all_text_len:], len(text)
+            delta, stopped = self._apply_stop_strings(req, delta, text)
+            finished = out.finished or stopped
+            reason = "stop" if stopped else out.finish_reason
+            src = None
+            if first_meta_pending:
+                first_meta_pending = False
+                src = (stream_resume.OUTCOME_RESTORED
+                       if req.resume_restored_tokens > 0
+                       else stream_resume.OUTCOME_RECOMPUTED)
+            chunk = self._chunk(req, delta, out, created, chat,
+                                finished=finished, finish_reason=reason,
+                                resume_src=src)
+            await write_frame(chunk)
+            if stopped and not out.finished:
+                # Safety net: the engine missed the stop string (e.g. it
+                # spanned a longer window); terminate and settle accounts.
+                self.engine.abort_request(req.request_id)
+                break
+            if finished:
+                break
+
+    async def resume_local(self, http_req: web.Request,
+                           resp: web.StreamResponse,
+                           journal: StreamJournal) -> bool:
+        """Resume a journaled stream on the LOCAL engine (the DP leader's
+        last resort when every worker host is down).  Writes the
+        remaining tokens into the already-committed client response;
+        returns True when the stream reached [DONE]."""
+        body = journal.resume_body()
+        chat = http_req.path.endswith("/chat/completions")
+        try:
+            req = self._make_request(
+                body, self._prompt_ids(body, chat),
+                {k.lower(): v for k, v in http_req.headers.items()})
+        except (TypeError, ValueError) as exc:
+            logger.error("local resume rejected: %s", exc)
+            return False
+        if req.deadline_expired():
+            return False
+        logger.warning("resuming stream %s on the local engine at token "
+                       "%d", req.request_id, journal.offset)
+        # The resumed stream is in-flight CLIENT work: count it so a
+        # drain waits for it (the drain contract lets in-flight requests
+        # complete) instead of declaring the replica idle mid-resume.
+        self._inflight += 1
+        if self.draining:
+            self.engine.metrics.drain_inflight.set(self._inflight)
+        try:
+            await self._stream_tokens_into(
+                resp, req, body, chat, int(time.time()), journal=journal)
+            await resp.write_eof()
+        except (ConnectionResetError, OSError):
+            # Any client-transport death (reset, EPIPE, TLS teardown):
+            # free the engine slot instead of decoding to max_tokens for
+            # a disconnected consumer.
+            self.async_engine.abort(req.request_id)
+            return False
+        except asyncio.CancelledError:
+            self.async_engine.abort(req.request_id)
+            raise
+        finally:
+            self._inflight -= 1
+            if self.draining:
+                self.engine.metrics.drain_inflight.set(self._inflight)
+        return journal.done
+
     def _sched_depth(self) -> int:
         """Scheduler depth (waiting + running) — the worker-side half of
         the DP pool's comparable-load contract."""
@@ -698,7 +983,8 @@ class ModelServer:
         return delta, False
 
     def _chunk(self, req, delta: str, out, created: int, chat: bool,
-               finished: bool, finish_reason: Optional[str]):
+               finished: bool, finish_reason: Optional[str],
+               resume_src: Optional[str] = None):
         choice: Dict[str, Any] = {
             "index": 0,
             "finish_reason": finish_reason if finished else None}
@@ -712,6 +998,14 @@ class ModelServer:
             "created": created, "model": self.model_name,
             "choices": [choice],
         }
+        # Journal meta: completion-token offset + ids of this chunk's new
+        # tokens (OpenAI clients ignore the extra key; the streaming
+        # relays journal it for mid-stream recovery and the load
+        # generator's continuity check keys on it).
+        chunk[stream_resume.CHUNK_META_KEY] = stream_resume.chunk_meta(
+            len(req.output_token_ids) - len(out.new_token_ids),
+            out.new_token_ids, src=resume_src,
+            restored_tokens=req.resume_restored_tokens)
         if out.finished and out.kv_transfer_params:
             chunk["kv_transfer_params"] = out.kv_transfer_params
         return chunk

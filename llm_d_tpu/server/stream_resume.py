@@ -1,0 +1,364 @@
+"""Journaled mid-stream recovery: the relay-side half of decode failover.
+
+P/D-Serve (arxiv 2408.08147) treats instance failure as routine at scale:
+requests on a dying decode instance are *recovered*, not failed.  This
+module is the machinery both streaming relays (the EPP gateway in
+``epp/service.py`` and the DP-leader relay in ``server/openai.py``) use
+to make an ungraceful decode-replica death invisible to an SSE client:
+
+  - a :class:`StreamJournal` records, per relayed stream, everything a
+    resume needs — the emitted completion-token ids and their offset
+    (prompt ids, sampling params, seed, SLO class and the ABSOLUTE
+    deadline already ride in the request body/headers, so the journal
+    only snapshots what the response stream adds);
+  - :func:`relay_stream` pumps upstream SSE frames to the client while
+    journaling, detects mid-stream death (upstream break, or a token
+    gap beyond the ``LLMD_STREAM_STALL_TIMEOUT_S`` watchdog), and
+    dedupes by token offset so a resumed upstream can never duplicate
+    or skip a token index;
+  - the resume handshake: the relay re-posts the original body plus
+    ``body["resume"] = {"offset": N, "token_ids": [...]}`` and the
+    ``x-llmd-resume-offset`` / ``x-llmd-resume-attempt`` headers; the
+    resume replica admits prompt+generated as a prefill whose blocks are
+    satisfied restore-first (prefix cache / host tier / shared tier) and
+    recompute-fallback, then continues emitting from offset N.
+
+Every streamed chunk carries an ``llmd`` extension object —
+``{"off": <completion-token index of the first token in this chunk>,
+"tok": [token ids]}``, plus ``"src": "restored"|"recomputed"`` on the
+first chunk after a resume — which OpenAI clients ignore and the relays
+journal.  :func:`verify_continuity` checks a collected stream for
+duplicate/missing token indices (the chaos suite's zero-break oracle;
+``scripts/generate_load.py`` runs it per stream under ``--stream``).
+
+Degradation ladder (in order): ``LLMD_STREAM_RESUME=0`` never journals
+(today's fail-fast contract, byte for byte); sheddable-class streams are
+never resumed; a resume is attempted at most ``LLMD_RESUME_MAX_ATTEMPTS``
+times per request and only while the request's deadline budget survives —
+past any of those, the break reaches the client exactly as it does today.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from llm_d_tpu.utils.config import env_float, env_int
+from llm_d_tpu.utils.faultinject import get_injector
+from llm_d_tpu.utils.lifecycle import (
+    RESUME_ATTEMPT_HEADER,
+    RESUME_OFFSET_HEADER,
+)
+
+# Key of the per-chunk journal extension object (see module docstring).
+CHUNK_META_KEY = "llmd"
+
+OUTCOME_RESTORED = "restored"
+OUTCOME_RECOMPUTED = "recomputed"
+OUTCOME_FAILED = "failed"
+
+
+class StreamBroken(Exception):
+    """The upstream stream died mid-flight (connection break, or EOF
+    before the ``[DONE]`` sentinel) — the resumable failure class."""
+
+
+class ClientGone(Exception):
+    """The CLIENT-side write failed: the consumer hung up mid-stream.
+    Deliberately NOT an OSError subclass — relays must let this
+    propagate (abort the request, free the slot) instead of treating it
+    as upstream death and burning resume attempts, worker backoff, and
+    prompt+generated re-prefills on a socket nobody reads."""
+
+
+class StreamStall(StreamBroken):
+    """The token-gap watchdog fired: no upstream bytes for
+    ``LLMD_STREAM_STALL_TIMEOUT_S`` seconds.  A wedged replica must be
+    failed over like a dead one — the client cannot tell them apart."""
+
+
+@dataclasses.dataclass
+class ResumePolicy:
+    enabled: bool
+    max_attempts: int
+    stall_timeout_s: float
+
+
+def resume_policy() -> ResumePolicy:
+    """Knobs re-read per request so operators (and tests) can flip them
+    on a live process; invalid values fall back per the env_* doctrine."""
+    return ResumePolicy(
+        enabled=env_int("LLMD_STREAM_RESUME", 1) != 0,
+        max_attempts=env_int("LLMD_RESUME_MAX_ATTEMPTS", 2),
+        stall_timeout_s=env_float("LLMD_STREAM_STALL_TIMEOUT_S", 0.0))
+
+
+def chunk_meta(off: int, token_ids: List[int],
+               src: Optional[str] = None,
+               restored_tokens: Optional[int] = None) -> Dict[str, Any]:
+    """The wire-side ``llmd`` extension a server attaches to each chunk."""
+    meta: Dict[str, Any] = {"off": off, "tok": list(token_ids)}
+    if src is not None:
+        meta["src"] = src
+        meta["restored"] = int(restored_tokens or 0)
+    return meta
+
+
+class StreamJournal:
+    """Per-relayed-stream resumable state + offset dedupe.
+
+    ``token_ids``/``offset`` grow as data frames pass through
+    :meth:`admit_frame`; ``done`` latches when the ``[DONE]`` sentinel is
+    relayed.  ``last_src`` carries the resume replica's restore-vs-
+    recompute verdict (first post-resume chunk's meta) for the
+    ``llmd_tpu:stream_resume_total{outcome}`` label.
+    """
+
+    def __init__(self, body: Dict[str, Any], criticality: str = "standard",
+                 deadline_epoch: Optional[float] = None) -> None:
+        self.body = body
+        self.criticality = criticality
+        self.deadline_epoch = deadline_epoch
+        self.token_ids: List[int] = []
+        # Chained resume: a body that ALREADY carries resume state (an
+        # upstream relay is resuming through this one) seeds the journal,
+        # so a second break re-resumes with the full token history — not
+        # a rebased offset missing the first N delivered tokens.
+        try:
+            self.token_ids = [int(t) for t in
+                              (body.get("resume") or {}).get(
+                                  "token_ids") or []]
+        except (TypeError, ValueError):
+            self.token_ids = []
+        self.done = False
+        self.resume_count = 0
+        self.last_src: Optional[str] = None
+        self.stream_id: Optional[str] = None   # chunk "id" (rid continuity)
+        # The stream's delivered finish_reason, if any: a break AFTER the
+        # finish chunk but BEFORE [DONE] needs no replica at all — the
+        # relay closes the stream itself (resuming would decode past a
+        # delivered EOS/stop and stream post-finish garbage).
+        self.finish_reason: Optional[str] = None
+        # Frames relayed without a parseable llmd meta: dedupe cannot
+        # protect these, so a journal that saw any is not resumable.
+        self.unjournaled_frames = 0
+        # Recovery accounting: mark_break() stamps the detection time;
+        # the first NEW token frame after it records (outcome, seconds)
+        # for llmd_tpu:stream_resume_total / request_recovery_seconds.
+        self._broke_at: Optional[float] = None
+        self._recoveries: List[Tuple[str, float]] = []
+
+    @property
+    def offset(self) -> int:
+        return len(self.token_ids)
+
+    @property
+    def resumable(self) -> bool:
+        return not self.done and self.unjournaled_frames == 0
+
+    def resume_body(self) -> Dict[str, Any]:
+        body = dict(self.body)
+        body["resume"] = {"offset": self.offset,
+                          "token_ids": list(self.token_ids)}
+        if self.stream_id and not body.get("request_id"):
+            # The resumed replica must emit chunks under the SAME stream
+            # id the client has been reading.
+            body["request_id"] = self.stream_id
+        return body
+
+    def resume_headers(self) -> Dict[str, str]:
+        return {RESUME_OFFSET_HEADER: str(self.offset),
+                RESUME_ATTEMPT_HEADER: str(self.resume_count)}
+
+    def mark_break(self) -> None:
+        """Stamp mid-stream-death detection; the next admitted token
+        frame closes the recovery-latency measurement."""
+        self._broke_at = time.monotonic()
+
+    def take_recoveries(self) -> List[Tuple[str, float]]:
+        """Drain completed (outcome, recovery_seconds) pairs."""
+        out, self._recoveries = self._recoveries, []
+        return out
+
+    def admit_frame(self, frame: bytes) -> bool:
+        """Journal one complete SSE frame; returns False when the frame
+        is a full duplicate of already-delivered tokens (a resumed
+        upstream replaying below the journal offset) and must NOT be
+        written to the client."""
+        payload = _frame_data(frame)
+        if payload is None:
+            return True                     # comment/heartbeat frame
+        if payload == b"[DONE]":
+            self.done = True
+            return True
+        try:
+            chunk = json.loads(payload)
+            meta = chunk.get(CHUNK_META_KEY)
+            if self.stream_id is None and chunk.get("id"):
+                self.stream_id = str(chunk["id"])
+        except (ValueError, AttributeError):
+            chunk = None
+            meta = None
+        if not isinstance(meta, dict) or "off" not in meta:
+            # Usage frames (choices=[]) and finals carry no tokens —
+            # relay; token-carrying frames without meta (a foreign
+            # server) disqualify the journal instead of risking a
+            # duplicate on resume.
+            if isinstance(meta, dict) or not _carries_tokens(chunk):
+                return True
+            self.unjournaled_frames += 1
+            return True
+        off = int(meta.get("off", 0))
+        toks = list(meta.get("tok") or [])
+        src = meta.get("src")
+        if src is not None:
+            self.last_src = str(src)
+        for choice in (chunk.get("choices") or []
+                       if isinstance(chunk, dict) else []):
+            if choice.get("finish_reason"):
+                self.finish_reason = choice["finish_reason"]
+        if toks and off + len(toks) <= self.offset:
+            return False                    # full duplicate: drop
+        # Normal case: off == self.offset (the resume replica starts
+        # exactly at the journal).  A gap/overlap is relayed anyway —
+        # verify_continuity is the oracle that flags it.
+        appended = False
+        for i, t in enumerate(toks):
+            pos = off + i
+            if pos < self.offset:
+                continue
+            self.token_ids.append(int(t))
+            appended = True
+        if appended and self._broke_at is not None:
+            self._recoveries.append(
+                (self.last_src or OUTCOME_RECOMPUTED,
+                 time.monotonic() - self._broke_at))
+            self._broke_at = None
+        return True
+
+
+def _frame_data(frame: bytes) -> Optional[bytes]:
+    """Payload of an SSE ``data:`` frame, or None for non-data frames."""
+    for line in frame.split(b"\n"):
+        if line.startswith(b"data:"):
+            return line[5:].strip()
+    return None
+
+
+def _carries_tokens(chunk: Any) -> bool:
+    if not isinstance(chunk, dict):
+        return False
+    for choice in chunk.get("choices") or []:
+        delta = choice.get("delta") or {}
+        if choice.get("text") or delta.get("content"):
+            return True
+    return False
+
+
+async def relay_stream(resp, content, journal: StreamJournal,
+                       fault_key: str = "",
+                       stall_timeout_s: float = 0.0) -> None:
+    """Pump upstream SSE into the client response while journaling.
+
+    Returns when the ``[DONE]`` sentinel has been relayed.  Raises
+    :class:`StreamBroken` on upstream EOF before ``[DONE]``,
+    :class:`StreamStall` when the token-gap watchdog fires, and lets
+    transport errors (``aiohttp.ClientError``) and the ``stream.relay``
+    injected fault propagate — all of which the caller's resume loop
+    treats as mid-stream death.  A CLIENT-side write failure raises
+    :class:`ClientGone` instead — the consumer hung up, so the caller
+    must abort, never resume.  Only COMPLETE frames reach the client: a
+    trailing partial frame at the break point is discarded, so the
+    resumed stream splices at a frame boundary.
+    """
+    buf = b""
+    while True:
+        await get_injector().acheck("stream.relay", key=fault_key)
+        if stall_timeout_s > 0:
+            try:
+                chunk = await asyncio.wait_for(
+                    content.readany(), stall_timeout_s)
+            except asyncio.TimeoutError:
+                raise StreamStall(
+                    f"no upstream bytes for {stall_timeout_s:.1f}s "
+                    f"(token-gap watchdog)") from None
+        else:
+            chunk = await content.readany()
+        if not chunk:
+            if journal.done:
+                return
+            raise StreamBroken("upstream closed before [DONE]")
+        buf += chunk
+        while b"\n\n" in buf:
+            frame, buf = buf.split(b"\n\n", 1)
+            frame += b"\n\n"
+            if journal.admit_frame(frame):
+                try:
+                    await resp.write(frame)
+                except (ConnectionResetError, OSError) as e:
+                    raise ClientGone(str(e) or type(e).__name__) from e
+        if journal.done:
+            return
+
+
+def parse_stream_payload(payload: bytes
+                         ) -> Tuple[str, List[Dict[str, Any]], bool]:
+    """Client-side view of a collected SSE byte stream: concatenated
+    token text, the per-chunk ``llmd`` metas (in arrival order), and
+    whether the ``[DONE]`` sentinel arrived.  Used by the load
+    generator's continuity check and the chaos suite."""
+    text_parts: List[str] = []
+    metas: List[Dict[str, Any]] = []
+    done = False
+    for frame in payload.split(b"\n\n"):
+        data = _frame_data(frame + b"\n")
+        if data is None:
+            continue
+        if data == b"[DONE]":
+            done = True
+            continue
+        try:
+            chunk = json.loads(data)
+        except ValueError:
+            continue
+        for choice in chunk.get("choices") or []:
+            delta = choice.get("delta") or {}
+            text_parts.append(choice.get("text") or delta.get("content")
+                              or "")
+        meta = chunk.get(CHUNK_META_KEY)
+        if isinstance(meta, dict):
+            metas.append(meta)
+    return "".join(text_parts), metas, done
+
+
+def verify_continuity(metas: List[Dict[str, Any]],
+                      expect_total: Optional[int] = None) -> List[str]:
+    """Zero-duplicate / zero-gap oracle over a stream's chunk metas.
+
+    Token index ``off + i`` of every chunk must run contiguously from 0:
+    a duplicate index means a resume replayed delivered tokens, a gap
+    means tokens were lost in the splice.  Returns human-readable
+    problems (empty = continuous)."""
+    problems: List[str] = []
+    expected = 0
+    for n, meta in enumerate(metas):
+        off = int(meta.get("off", -1))
+        toks = list(meta.get("tok") or [])
+        if not toks:
+            continue
+        if off < expected:
+            problems.append(
+                f"chunk {n}: duplicate token indices {off}..{off + len(toks) - 1} "
+                f"(already delivered through {expected - 1})")
+        elif off > expected:
+            problems.append(
+                f"chunk {n}: missing token indices {expected}..{off - 1}")
+        expected = max(expected, off + len(toks))
+    if expect_total is not None and expected != expect_total:
+        problems.append(
+            f"stream delivered {expected} token indices, expected "
+            f"{expect_total}")
+    return problems

@@ -38,6 +38,8 @@ from llm_d_tpu.utils.lifecycle import (
     DEADLINE_EXCEEDED_HEADER,
     PREFILL_FALLBACK_HEADER,
     PREFILLER_HEADER,
+    RESUME_ATTEMPT_HEADER,
+    RESUME_OFFSET_HEADER,
     parse_criticality,
     parse_deadline,
     remaining_s,
@@ -138,11 +140,19 @@ class RoutingSidecar:
             fwd_headers[DEADLINE_ABS_HEADER] = f"{deadline_epoch:.6f}"
         if rid:
             fwd_headers["x-request-id"] = rid
+        for h in (RESUME_OFFSET_HEADER, RESUME_ATTEMPT_HEADER):
+            if h in in_headers:
+                fwd_headers[h] = in_headers[h]
         hint = request.headers.get(PREFILLER_HEADER) or \
             self.static_prefiller or ""
         prefillers = [p.strip() for p in hint.split(",") if p.strip()]
         local_fallback = False
-        if prefillers and not body.get("kv_transfer_params"):
+        # A mid-stream RESUME never goes through remote prefill: the
+        # decode pod admits prompt+generated locally, restore-first from
+        # its prefix cache / host tier (a remote prefill could only
+        # cover the prompt region and would waste a prefill pod).
+        if prefillers and not body.get("kv_transfer_params") \
+                and not body.get("resume"):
             decode_body = await self._prefill_with_failover(
                 request.path, body, prefillers, rid,
                 deadline_epoch=deadline_epoch, fwd_headers=fwd_headers)

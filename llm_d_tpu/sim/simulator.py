@@ -29,10 +29,13 @@ from typing import Any, Dict, List, Optional
 
 from aiohttp import web
 
+from llm_d_tpu.server import stream_resume
+from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 from llm_d_tpu.utils.hashing import hash_token_blocks
 from llm_d_tpu.utils.lifecycle import (
     DEADLINE_EXCEEDED_HEADER,
     DRAINING_HEADER,
+    RESUME_OFFSET_HEADER,
     parse_criticality,
     parse_deadline,
 )
@@ -85,6 +88,11 @@ class InferenceSimulator:
         # in-flight requests complete — the chaos suite roll-restarts an
         # entire sim fleet against this flag.
         self.draining = False
+        # Engine-death mirror: the ``engine.step`` fault point fires in a
+        # token loop (keyed by model name, so a chaos run kills ONE
+        # replica via match=) — every in-flight stream breaks abruptly
+        # and new work is refused, exactly like a crashed engine core.
+        self.dead = False
         self._running = 0
         self._waiting = 0
         self._blocks_used = 0          # simulated KV blocks held
@@ -148,7 +156,8 @@ class InferenceSimulator:
 
     async def admit(self, prompt_ids: List[int], max_tokens: int,
                     deadline_epoch: Optional[float] = None,
-                    criticality: str = "standard") -> Dict[str, Any]:
+                    criticality: str = "standard",
+                    start: int = 0) -> Dict[str, Any]:
         """Queue for a running slot.  Raises :class:`DeadlineExceeded`
         when the budget expires while queued (mirrors the real
         scheduler's queued-deadline rejection; the simulated KV blocks
@@ -183,7 +192,8 @@ class InferenceSimulator:
         return {"prompt_ids": prompt_ids, "max_tokens": max_tokens,
                 "deadline_epoch": deadline_epoch,
                 "criticality": criticality, "n_blocks": n_blocks,
-                "arrival": arrival, "expired": False, "released": False}
+                "arrival": arrival, "expired": False, "released": False,
+                "start": start, "resume_src": None, "resume_restored": 0}
 
     def release_ticket(self, ticket: Dict[str, Any]) -> None:
         """Idempotent slot/block release.  ``stream_tokens`` calls this in
@@ -199,15 +209,30 @@ class InferenceSimulator:
         self._update_gauges()
 
     async def stream_tokens(self, ticket: Dict[str, Any]):
-        """Yields (token_text, is_first) at the simulated rate for an
+        """Yields (token_index, token_text) at the simulated rate for an
         admitted ticket; releases the slot + blocks on exit.  A deadline
         that expires mid-generation truncates at the next token boundary
         (``ticket["expired"]`` turns True) — the real engine's
-        step-boundary eviction."""
+        step-boundary eviction.
+
+        Token i's text depends only on (prompt, i), so a RESUME ticket
+        (``start`` > 0 — the gateway relay's journal offset) continues
+        the exact sequence an uninterrupted run would have produced: the
+        chaos suite's byte-identical continuity oracle.  The resume
+        handshake's restore-vs-recompute verdict lands in
+        ``ticket["resume_src"]`` before the first yield (restore-first
+        from the prefix cache standing in for the host/shared KV tier;
+        a fired ``kv.restore`` fault degrades to recompute at full TTFT).
+
+        The ``engine.step`` fault point (keyed by model name) mirrors
+        engine death: the firing stream raises out of its handler — the
+        connection breaks without [DONE] — and the whole replica turns
+        ``dead`` (every other in-flight stream breaks, new work 500s)."""
         c = self.config
         prompt_ids = ticket["prompt_ids"]
         arrival = ticket["arrival"]
         deadline_epoch = ticket["deadline_epoch"]
+        start = ticket.get("start", 0)
         try:
             cached = self._prefix_hit_tokens(prompt_ids)
             self.metrics.prefix_cache_queries.inc(len(prompt_ids))
@@ -218,14 +243,37 @@ class InferenceSimulator:
             # prefix scorers exploit).
             miss_frac = 1.0 - min(cached, len(prompt_ids)) / max(
                 1, len(prompt_ids))
+            if start:
+                restored = cached > 0
+                try:
+                    await get_injector().acheck("kv.restore", key=c.model)
+                except FaultInjected:
+                    restored = False
+                ticket["resume_src"] = (
+                    stream_resume.OUTCOME_RESTORED if restored
+                    else stream_resume.OUTCOME_RECOMPUTED)
+                ticket["resume_restored"] = start if restored else 0
+                # Restored resume skips the prompt+generated recompute;
+                # a tier miss replays it as a full prefill.
+                miss_frac = 0.0 if restored else 1.0
             await asyncio.sleep(c.ttft_ms / 1e3 * max(miss_frac, 0.1))
             self.metrics.prompt_tokens.inc(len(prompt_ids))
             self.metrics.time_to_first_token.observe(
                 time.monotonic() - arrival)
             self._store_prefix(prompt_ids)
             reason = "length"
-            for i in range(ticket["max_tokens"]):
-                if i > 0:
+            emitted = 0
+            for i in range(start, ticket["max_tokens"]):
+                if self.dead:
+                    raise RuntimeError("engine dead")
+                try:
+                    await get_injector().acheck("engine.step", key=c.model)
+                except FaultInjected:
+                    self.dead = True
+                    logger.error("sim %s: engine.step fault — replica is "
+                                 "now dead", c.model)
+                    raise
+                if emitted > 0:
                     await asyncio.sleep(c.tpot_ms / 1e3)
                     self.metrics.inter_token_latency.observe(c.tpot_ms / 1e3)
                 if deadline_epoch is not None \
@@ -237,7 +285,8 @@ class InferenceSimulator:
                     break
                 word = _LOREM[(len(prompt_ids) + i) % len(_LOREM)]
                 self.metrics.generation_tokens.inc()
-                yield (word + " ", i == 0)
+                emitted += 1
+                yield (i, word + " ")
             self.metrics.request_success.labels(
                 model_name=self.config.model,
                 finished_reason=reason).inc()
@@ -290,9 +339,13 @@ class SimServer:
         asyncio.get_running_loop().create_task(load())
 
     async def health(self, request: web.Request) -> web.Response:
+        if self.sim.dead:
+            return web.Response(status=500, text="engine dead")
         return web.Response(text="ok")
 
     async def models(self, request: web.Request) -> web.Response:
+        if self.sim.dead:
+            return web.json_response({"error": "engine dead"}, status=503)
         if not self.sim.model_loaded:
             return web.json_response({"error": "model loading"}, status=503)
         if self.sim.draining:
@@ -321,6 +374,11 @@ class SimServer:
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
         rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
+        if self.sim.dead:
+            # Dead-engine mirror: fail fast like the real server's
+            # /health-500 engine (gateway retries/resumes elsewhere).
+            return web.json_response(
+                {"error": "engine dead", "request_id": rid}, status=500)
         if self.sim.draining:
             # Same contract as the real server: new inference 503s while
             # draining; the gateway's retry path re-schedules elsewhere.
@@ -349,12 +407,29 @@ class SimServer:
         created = int(time.time())
         stream = bool(body.get("stream", False))
         model = self.sim.config.model
+        # Mid-stream resume handshake (mirrors the real model server):
+        # the relay's journal offset arrives as x-llmd-resume-offset /
+        # body["resume"]; token i depends only on (prompt, i), so the
+        # continuation is byte-identical to an uninterrupted run.
+        resume = body.get("resume") or {}
+        try:
+            start = int(in_headers.get(RESUME_OFFSET_HEADER,
+                                       resume.get("offset") or 0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "invalid resume offset", "request_id": rid},
+                status=400)
+        if not 0 <= start <= max_tokens:
+            return web.json_response(
+                {"error": f"resume offset {start} out of range",
+                 "request_id": rid}, status=400)
 
         try:
             # Admission BEFORE the stream is prepared so a queued-deadline
             # expiry can still answer an honest 504.
             ticket = await self.sim.admit(prompt_ids, max_tokens,
-                                          deadline_epoch, criticality)
+                                          deadline_epoch, criticality,
+                                          start=start)
         except DeadlineExceeded:
             return web.json_response(
                 {"error": "deadline exceeded", "request_id": rid},
@@ -371,10 +446,9 @@ class SimServer:
                 # can't fire, so release here or the slot leaks.
                 self.sim.release_ticket(ticket)
                 raise
-            i = 0
-            async for text, _first in self.sim.stream_tokens(ticket):
-                i += 1
-                finished = i == max_tokens
+            first = True
+            async for i, text in self.sim.stream_tokens(ticket):
+                finished = i == max_tokens - 1
                 choice: Dict[str, Any] = {
                     "index": 0,
                     "finish_reason": "length" if finished else None}
@@ -382,10 +456,17 @@ class SimServer:
                     choice["delta"] = {"content": text}
                 else:
                     choice["text"] = text
+                src = ticket["resume_src"] if first and start else None
+                first = False
                 chunk = {"id": rid, "created": created, "model": model,
                          "object": ("chat.completion.chunk" if chat
                                     else "text_completion"),
-                         "choices": [choice]}
+                         "choices": [choice],
+                         stream_resume.CHUNK_META_KEY:
+                         stream_resume.chunk_meta(
+                             i, [(len(prompt_ids) + i) % len(_LOREM)],
+                             src=src,
+                             restored_tokens=ticket["resume_restored"])}
                 await resp.write(b"data: " + json.dumps(chunk).encode()
                                  + b"\n\n")
             await resp.write(b"data: [DONE]\n\n")
@@ -393,7 +474,7 @@ class SimServer:
             return resp
 
         parts: List[str] = []
-        async for text, _first in self.sim.stream_tokens(ticket):
+        async for _i, text in self.sim.stream_tokens(ticket):
             parts.append(text)
         full = "".join(parts)
         if ticket["expired"] and not parts:

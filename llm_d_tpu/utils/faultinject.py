@@ -13,8 +13,15 @@ Fault-point catalog (see docs/resilience.md):
 
   ``sidecar.prefill``   sidecar -> prefill HTTP post (proxy.py)
   ``gateway.forward``   gateway -> decode replica forward (epp/service.py)
+  ``stream.relay``      mid-stream gateway -> backend relay frame
+                        (server/stream_resume.py) — a connection that
+                        drops AFTER response bytes were committed,
+                        distinct from ``engine.step`` death
   ``kv.pull``           TpuConnector consumer KV fetch (transfer/connector.py)
   ``kv.peer_fetch``     shared-tier peer block fetch (engine/offload.py)
+  ``kv.restore``        host/shared-tier block restore during (resume)
+                        admission (engine/offload.py) — a fired fault is
+                        a tier miss: the request recomputes instead
   ``engine.step``       engine step — simulated engine death (engine.py)
 
 Rules come from code (tests: ``install(FaultInjector(...))``) or from the
@@ -59,8 +66,10 @@ logger = logging.getLogger(__name__)
 FAULT_POINTS = (
     "sidecar.prefill",
     "gateway.forward",
+    "stream.relay",
     "kv.pull",
     "kv.peer_fetch",
+    "kv.restore",
     "engine.step",
 )
 

@@ -35,6 +35,15 @@ drift apart:
                          alternates after).
   x-llmd-prefill-fallback  response marker: every prefiller failed and
                          the decode pod recomputed the prefill locally.
+  x-llmd-resume-offset   request header on a mid-stream RESUME forward:
+                         completion tokens already delivered to the
+                         client (the relay's journal length).  The
+                         resume replica admits prompt+generated as a
+                         prefill and emits tokens from this offset; the
+                         relay dedupes on it so the client stream has
+                         no duplicate or missing token indices.
+  x-llmd-resume-attempt  request header: resume attempt index (1..max),
+                         for upstream log correlation and loop bounds.
 
 Criticality maps to priority *tiers* consumed by the engine scheduler's
 ``(priority, arrival)`` queue order and by preemption victim selection:
@@ -61,6 +70,8 @@ RETRY_ATTEMPT_HEADER = "x-llmd-retry-attempt"
 RETRY_BUDGET_HEADER = "x-llmd-retry-budget"
 PREFILLER_HEADER = "x-prefiller-host-port"
 PREFILL_FALLBACK_HEADER = "x-llmd-prefill-fallback"
+RESUME_OFFSET_HEADER = "x-llmd-resume-offset"
+RESUME_ATTEMPT_HEADER = "x-llmd-resume-attempt"
 
 CRITICALITY_CRITICAL = "critical"
 CRITICALITY_STANDARD = "standard"

@@ -31,6 +31,15 @@ from prometheus_client import (
 # these constants.
 DRAIN_STATE_METRIC = "llmd_tpu:drain_state"
 COLLECTIVE_BYTES_METRIC = "llmd_tpu:collective_bytes_total"
+# Mid-stream recovery (journaled decode failover): resumes by outcome
+# (restored = generated-region KV came back from the prefix cache /
+# host/shared tier; recomputed = tier miss, replayed as prefill;
+# failed = budget/attempts gone, the break reached the client) and the
+# detection->first-resumed-token latency.  Declared on BOTH the gateway
+# (EppMetrics) and the model server's DP relay (EngineMetrics) — the two
+# relays that journal streams; registries are per-component.
+STREAM_RESUME_METRIC = "llmd_tpu:stream_resume_total"
+REQUEST_RECOVERY_METRIC = "llmd_tpu:request_recovery_seconds"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -144,6 +153,20 @@ class EngineMetrics:
             "estimated from routed tokens), by collective and wire "
             "dtype.",
             ["model_name", "collective", "dtype"], registry=self.registry)
+        # Mid-stream recovery at the DP-leader relay (the gateway-side
+        # twin lives on EppMetrics; see the module-level constants).
+        self._stream_resume = Counter(
+            STREAM_RESUME_METRIC,
+            "Mid-stream resumes at this relay, by outcome "
+            "(restored | recomputed | failed).",
+            ["model_name", "outcome"], registry=self.registry)
+        self.request_recovery = histo(
+            REQUEST_RECOVERY_METRIC,
+            "Mid-stream break detection to first resumed token.")
+
+    def inc_stream_resume(self, outcome: str) -> None:
+        self._stream_resume.labels(
+            model_name=self.model_name, outcome=outcome).inc()
 
     def observe_queue_wait(self, criticality: str, seconds: float) -> None:
         self._queue_wait.labels(
@@ -226,6 +249,16 @@ class EppMetrics:
             "llmd_tpu:gateway_deadline_exceeded_total",
             "Requests 504'd at the gateway because their deadline passed.",
             ["criticality"], registry=self.registry)
+        # Mid-stream recovery (journaled decode failover at the relay).
+        self.stream_resume = Counter(
+            STREAM_RESUME_METRIC,
+            "Mid-stream resumes at the gateway relay, by outcome "
+            "(restored | recomputed | failed).",
+            ["outcome"], registry=self.registry)
+        self.request_recovery = Histogram(
+            REQUEST_RECOVERY_METRIC,
+            "Mid-stream break detection to first resumed token.",
+            buckets=_TIME_BUCKETS, registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -21,6 +21,11 @@ for f in scripts/*.sh docs/monitoring/scripts/*.sh; do bash -n "$f"; done
 # suite runs exactly once.
 python -m pytest tests/test_chaos.py -q
 python -m pytest tests/test_lifecycle.py -q
+# Mid-stream recovery gate (journaled decode failover): engine death
+# under sustained streaming load must produce ZERO client-visible
+# stream breaks — restore-or-recompute resume, offset dedupe, breaker
+# exclusion, and the LLMD_STREAM_RESUME=0 fail-fast contract.
+python -m pytest tests/test_stream_recovery.py -q
 # int8 paged-KV contract fail-fast (kv_cache_dtype=int8: kernel/fallback
 # parity bounds, offload scale round-trip, wire dtype rejection, pool
 # sizing): a silent KV-numerics or wire-format break must not merge.
@@ -37,4 +42,5 @@ python -m pytest tests/test_collective_quant.py -q
 python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_mla_quant.py \
-    --ignore=tests/test_collective_quant.py
+    --ignore=tests/test_collective_quant.py \
+    --ignore=tests/test_stream_recovery.py

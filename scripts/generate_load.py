@@ -18,6 +18,10 @@ Examples:
   python scripts/generate_load.py --url http://gw:8000 --deadline-ms 800 \
       --criticality-mix critical:0.2,standard:0.6,sheddable:0.2
       # lifecycle traffic: per-class p50/p99 + deadline-miss rate
+  python scripts/generate_load.py --url http://gw:8000 --stream --qps 10
+      # SSE streams with the continuity oracle: stream_breaks and
+      # continuity_errors in the summary must be 0 under mid-stream
+      # recovery chaos (see docs/resilience.md)
 
 Client-side fault kinds (--faults kind:rate[,kind:rate...], mirroring the
 reference error-injection load script):
@@ -40,6 +44,10 @@ import aiohttp
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from llm_d_tpu.server.stream_resume import (  # noqa: E402
+    parse_stream_payload,
+    verify_continuity,
+)
 from llm_d_tpu.utils.lifecycle import (  # noqa: E402
     CRITICALITY_HEADER,
     DEADLINE_EXCEEDED_HEADER,
@@ -152,6 +160,37 @@ async def one_request(session, args, rng, stats) -> None:
                     break
                 resp.close()
             stats["aborted"] = stats.get("aborted", 0) + 1
+        elif getattr(args, "stream", False):
+            # Streaming with the continuity oracle: every token index
+            # 0..n-1 must arrive exactly once ([DONE] must close it) —
+            # a mid-stream failover that duplicates or drops a token is
+            # a continuity error; a missing [DONE] is a stream break.
+            body = dict(body, stream=True)
+            async with session.post(f"{args.url}/v1/completions", json=body,
+                                    headers=headers, **kw) as resp:
+                try:
+                    payload = await resp.read()
+                    broke = False
+                except aiohttp.ClientError:
+                    # Abrupt mid-stream connection break (the fail-fast
+                    # contract's shape): as much a stream break as a
+                    # clean EOF without [DONE].
+                    payload = b""
+                    broke = True
+                stats[resp.status] = stats.get(resp.status, 0) + 1
+                if resp.status == 504 or resp.headers.get(
+                        DEADLINE_EXCEEDED_HEADER):
+                    cls["deadline_miss"] += 1
+                if resp.status == 200:
+                    _text, metas, done = parse_stream_payload(payload)
+                    problems = verify_continuity(metas)
+                    if broke or not done:
+                        stats["stream_breaks"] = \
+                            stats.get("stream_breaks", 0) + 1
+                    if problems:
+                        stats["continuity_errors"] = \
+                            stats.get("continuity_errors", 0) + len(problems)
+                        print(f"continuity: {problems}")
         else:
             async with session.post(f"{args.url}/v1/completions", json=body,
                                     headers=headers, **kw) as resp:
@@ -199,14 +238,20 @@ async def run(args) -> None:
                 c["deadline_miss"] / c["requests"], 4)
             if c["requests"] else 0.0,
         }
-    print(json.dumps({
+    breaks = stats.pop("stream_breaks", 0)
+    cont_errors = stats.pop("continuity_errors", 0)
+    summary = {
         "requests": sum(v for v in stats.values()),
         "status_counts": stats,
         "latency_p50_s": round(pct(lats, 0.5), 4),
         "latency_p90_s": round(pct(lats, 0.9), 4),
         "latency_p99_s": round(pct(lats, 0.99), 4),
         "per_class": per_class,
-    }))
+    }
+    if args.stream:
+        summary["stream_breaks"] = breaks
+        summary["continuity_errors"] = cont_errors
+    print(json.dumps(summary))
 
 
 def main() -> None:
@@ -238,6 +283,12 @@ def main() -> None:
                     help="client-side fault mix, kind:rate[,kind:rate...]; "
                          "kinds: malformed, abort, timeout (see module "
                          "docstring)")
+    ap.add_argument("--stream", action="store_true",
+                    help="SSE streaming requests with the continuity "
+                         "oracle: the summary counts stream_breaks "
+                         "(missing [DONE]) and continuity_errors "
+                         "(duplicated/missing token indices) — both must "
+                         "be 0 under mid-stream recovery chaos")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
     args.fault_map = parse_faults(args.faults)
