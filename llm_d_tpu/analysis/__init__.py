@@ -20,7 +20,24 @@ Passes (see docs/static-analysis.md for the rule table):
   jit       JIT     host-sync hygiene inside jit-decorated and
                     engine-step-reachable functions.
   async     ASYNC   blocking primitives inside ``async def`` / async
-                    modules, locks held across ``await``.
+                    modules, locks held across ``await``; ASYNC001 is
+                    routed through the project call graph, so blocking
+                    calls in sync helpers other modules own are caught.
+  race      RACE    interprocedural async races over the call graph
+                    (``analysis/callgraph.py``): unguarded check-then-
+                    act windows across ``await`` on shared ``self.X``,
+                    locks held across transitively-reached blocking
+                    calls, lock-order deadlock cycles.
+  task      TASK    asyncio task lifecycle: dropped ``create_task``
+                    handles, never-awaited coroutines, broad bare-pass
+                    exception swallows in coroutine context.
+  pair      PAIR    resource-lifecycle effect pairing on ALL paths
+                    (exception paths included): slot/inflight counters,
+                    KV block take/release, breaker record_* balance,
+                    producer pins, stream-journal recovery accounting.
+  fault     FAULT   fault-point coverage: every check()/acheck() point
+                    has a docs/resilience.md row, a test, and a
+                    FAULT_POINTS catalog entry.
   pallas    PAL     Pallas kernel invariants: DMA start/wait pairing,
                     int8 tiling divisibility gates, --interpret parity
                     test coverage.

@@ -1,0 +1,415 @@
+"""Project-wide call graph with coroutine-context propagation.
+
+The lexical passes (PR 7) stop at function boundaries: ASYNC001 sees a
+``time.sleep`` inside an ``async def`` but not one reached through a sync
+helper two modules away, and no lexical rule can ask "which contexts
+mutate this attribute?".  This module builds the interprocedural
+substrate the RACE / TASK / PAIR rule families (and the upgraded ASYNC
+pass) share:
+
+  - every top-level function and every class method in ``package_files``
+    + ``script_files`` becomes a node (``FuncNode``), keyed by
+    ``"<rel>::<Class.>name"``;
+  - call edges are resolved conservatively, one hop deep:
+      * bare names against the module's own top-level defs and its
+        ``from x import f`` imports,
+      * ``self.m()`` against the enclosing class, then single-hop base
+        classes resolvable in the same module or through imports,
+      * ``alias.f()`` against ``import llm_d_tpu.x as alias`` /
+        ``from llm_d_tpu import x``,
+      * ``obj.m()`` against a one-hop type binding for ``obj``: a
+        parameter annotation (``journal: StreamJournal``), a local
+        ``obj = ClassName(...)`` assignment, or a ``self.attr =
+        ClassName(...)`` binding made in the class's ``__init__``;
+  - coroutine-context propagation: every ``async def`` is a root; any
+    node reachable from a root over resolved edges runs (at least
+    sometimes) on an event loop.  ``coroutine_roots[qname]`` names the
+    async roots that reach each node, so findings can say *which*
+    coroutine drags a sync helper onto the loop.
+
+The model is deliberately under-approximate — unresolvable dynamic
+dispatch (callbacks, ``getattr``, dict-of-functions) produces NO edge
+rather than a guessed one, so the passes built on top err toward missing
+a finding, never toward inventing an unreachable path.  Known limits are
+documented in docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from llm_d_tpu.analysis.core import Context
+
+
+@dataclasses.dataclass
+class FuncNode:
+    qname: str                       # "<rel>::Class.method" / "<rel>::fn"
+    rel: str                         # repo-relative posix path
+    name: str                        # bare function name
+    cls: Optional[str]               # enclosing class name, if a method
+    node: ast.AST                    # FunctionDef | AsyncFunctionDef
+    is_async: bool
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+    @property
+    def label(self) -> str:
+        """Human-readable site for finding messages."""
+        base = f"{self.cls}.{self.name}" if self.cls else self.name
+        return f"{base} ({self.rel}:{self.lineno})"
+
+
+class _ModuleIndex:
+    """Per-module name tables used during edge resolution."""
+
+    def __init__(self, rel: str, tree: ast.Module,
+                 mod_of_rel: Dict[str, str]) -> None:
+        self.rel = rel
+        # alias -> project rel path (``import llm_d_tpu.x as alias``).
+        self.import_alias: Dict[str, str] = {}
+        # name -> (rel, original name) (``from llm_d_tpu.x import f``).
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        # class name -> {method name -> qname}; and base-class names.
+        self.classes: Dict[str, Dict[str, str]] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        # top-level function name -> qname.
+        self.functions: Dict[str, str] = {}
+        rel_of_mod = {m: r for r, m in mod_of_rel.items()}
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    tgt = rel_of_mod.get(a.name)
+                    if not tgt:
+                        continue
+                    if a.asname:
+                        self.import_alias[a.asname] = tgt
+                    elif "." not in a.name:
+                        # Plain ``import a.b.c`` binds only ``a`` — naming
+                        # the leaf would fabricate edges for any local that
+                        # happens to share it.
+                        self.import_alias[a.name] = tgt
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    # ``from llm_d_tpu.server import stream_resume`` binds a
+                    # MODULE; ``from ...stream_resume import relay_stream``
+                    # binds a function (resolved against the module later).
+                    sub = rel_of_mod.get(f"{node.module}.{a.name}")
+                    if sub:
+                        self.import_alias[a.asname or a.name] = sub
+                        continue
+                    src = rel_of_mod.get(node.module)
+                    if src:
+                        self.from_imports[a.asname or a.name] = (src, a.name)
+
+
+class CallGraph:
+    """See module docstring.  Build once per Context via :meth:`build`
+    (the Context caches it, so every pass shares one graph)."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, FuncNode] = {}
+        self.edges: Dict[str, Set[str]] = {}
+        # qname -> async-def roots that reach it (empty set = only ever
+        # called from plain threads, as far as the graph can prove).
+        self.coroutine_roots: Dict[str, Set[str]] = {}
+        self._indexes: Dict[str, _ModuleIndex] = {}
+        self._mod_of_rel: Dict[str, str] = {}
+        # Per-function type tables, filled during edge construction and
+        # reused by resolve_call (passes call it once per ast.Call —
+        # recomputing the tables there would be O(calls x fn size)).
+        self._local_types_cache: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        self._attr_types_cache: Dict[Tuple[str, str],
+                                     Dict[str, Tuple[str, str]]] = {}
+
+    # ---------- queries ----------
+
+    def node(self, qname: str) -> Optional[FuncNode]:
+        return self.functions.get(qname)
+
+    def is_coroutine_context(self, qname: str) -> bool:
+        """Does this function ever run on an event loop: it IS a
+        coroutine, or some coroutine (transitively) calls it."""
+        fn = self.functions.get(qname)
+        if fn is not None and fn.is_async:
+            return True
+        return bool(self.coroutine_roots.get(qname))
+
+    def roots_of(self, qname: str) -> Set[str]:
+        fn = self.functions.get(qname)
+        roots = set(self.coroutine_roots.get(qname, ()))
+        if fn is not None and fn.is_async:
+            roots.add(qname)
+        return roots
+
+    def resolve_call(self, qname: str, call: ast.Call) -> Optional[str]:
+        """Resolve one call expression made inside ``qname`` with the
+        same rules edge construction used (passes use this instead of
+        name-matching against the edge set, which would confuse
+        ``asyncio.run(...)`` with a project function named ``run``)."""
+        fn = self.functions.get(qname)
+        if fn is None:
+            return None
+        idx = self._indexes.get(fn.rel)
+        if idx is None:
+            return None
+        local_types = self._local_types_cache.get(qname)
+        if local_types is None:
+            local_types = self._local_types(idx, fn)
+            self._local_types_cache[qname] = local_types
+        attr_key = (fn.rel, fn.cls or "")
+        attr_types = self._attr_types_cache.get(attr_key)
+        if attr_types is None:
+            attr_types = self._attr_types_of(idx, fn.cls)
+            self._attr_types_cache[attr_key] = attr_types
+        return self._resolve_call(idx, fn, call, local_types, attr_types)
+
+    def _attr_types_of(self, idx: _ModuleIndex, cls: Optional[str]
+                       ) -> Dict[str, Tuple[str, str]]:
+        binds: Dict[str, Tuple[str, str]] = {}
+        if not cls:
+            return binds
+        init_q = idx.classes.get(cls, {}).get("__init__")
+        if init_q:
+            for node in ast.walk(self.functions[init_q].node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    t = self._class_of_call(idx, node.value)
+                    if t is None:
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            binds[tgt.attr] = t
+        return binds
+
+    # ---------- construction ----------
+
+    @classmethod
+    def build(cls, ctx: Context) -> "CallGraph":
+        cached = getattr(ctx, "_callgraph", None)
+        if cached is not None:
+            return cached
+        g = cls()
+        rels = [r for r in list(ctx.package_files) + list(ctx.script_files)]
+        trees: Dict[str, ast.Module] = {}
+        for rel in rels:
+            tree = ctx.source(rel).tree
+            if tree is None:
+                continue
+            trees[rel] = tree
+            g._mod_of_rel[rel] = rel[:-3].replace("/", ".")
+        for rel, tree in trees.items():
+            g._index_module(rel, tree)
+        for rel, tree in trees.items():
+            g._resolve_module(rel, tree)
+        g._propagate_coroutine_contexts()
+        ctx._callgraph = g
+        return g
+
+    def _index_module(self, rel: str, tree: ast.Module) -> None:
+        idx = _ModuleIndex(rel, tree, self._mod_of_rel)
+        self._indexes[rel] = idx
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{rel}::{node.name}"
+                idx.functions[node.name] = q
+                self.functions[q] = FuncNode(
+                    q, rel, node.name, None, node,
+                    isinstance(node, ast.AsyncFunctionDef))
+            elif isinstance(node, ast.ClassDef):
+                methods: Dict[str, str] = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        q = f"{rel}::{node.name}.{sub.name}"
+                        methods[sub.name] = q
+                        self.functions[q] = FuncNode(
+                            q, rel, sub.name, node.name, sub,
+                            isinstance(sub, ast.AsyncFunctionDef))
+                idx.classes[node.name] = methods
+                idx.class_bases[node.name] = [
+                    b.id for b in node.bases if isinstance(b, ast.Name)]
+
+    # -- per-function resolution --
+
+    def _resolve_module(self, rel: str, tree: ast.Module) -> None:
+        idx = self._indexes[rel]
+        # One-hop attribute types: ``self.x = ClassName(...)`` in __init__.
+        for cname in idx.classes:
+            self._attr_types_cache[(rel, cname)] = \
+                self._attr_types_of(idx, cname)
+        self._attr_types_cache.setdefault((rel, ""), {})
+        for q, fn in list(self.functions.items()):
+            if fn.rel != rel:
+                continue
+            self.edges.setdefault(q, set())
+            local_types = self._local_types(idx, fn)
+            self._local_types_cache[q] = local_types
+            # Nested defs excluded: a closure's calls run when IT runs
+            # (executor, thread target, spawned task) — attributing them
+            # here would propagate coroutine context into helpers that
+            # never touch the loop, inventing unreachable paths.
+            for node in walk_excluding_nested_defs(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self._resolve_call(
+                    idx, fn, node, local_types,
+                    self._attr_types_cache[(rel, fn.cls or "")])
+                if callee:
+                    self.edges[q].add(callee)
+
+    def _class_of_call(self, idx: _ModuleIndex,
+                       call: ast.Call) -> Optional[Tuple[str, str]]:
+        """``ClassName(...)`` -> (rel, class name), resolving imports."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in idx.classes:
+                return (idx.rel, f.id)
+            imp = idx.from_imports.get(f.id)
+            if imp:
+                other = self._indexes.get(imp[0])
+                if other and imp[1] in other.classes:
+                    return (imp[0], imp[1])
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            mod_rel = idx.import_alias.get(f.value.id)
+            if mod_rel:
+                other = self._indexes.get(mod_rel)
+                if other and f.attr in other.classes:
+                    return (mod_rel, f.attr)
+        return None
+
+    def _local_types(self, idx: _ModuleIndex,
+                     fn: FuncNode) -> Dict[str, Tuple[str, str]]:
+        """name -> (rel, class) from parameter annotations and
+        ``name = ClassName(...)`` assignments in the body."""
+        out: Dict[str, Tuple[str, str]] = {}
+        args = fn.node.args
+        for a in list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs):
+            ann = a.annotation
+            if isinstance(ann, ast.Name):
+                t = self._resolve_class_name(idx, ann.id)
+                if t:
+                    out[a.arg] = t
+            elif isinstance(ann, ast.Constant) \
+                    and isinstance(ann.value, str):
+                t = self._resolve_class_name(idx, ann.value)
+                if t:
+                    out[a.arg] = t
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                t = self._class_of_call(idx, node.value)
+                if t is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = t
+        return out
+
+    def _resolve_class_name(self, idx: _ModuleIndex,
+                            name: str) -> Optional[Tuple[str, str]]:
+        if name in idx.classes:
+            return (idx.rel, name)
+        imp = idx.from_imports.get(name)
+        if imp:
+            other = self._indexes.get(imp[0])
+            if other and imp[1] in other.classes:
+                return (imp[0], imp[1])
+        return None
+
+    def _method_in_class(self, rel: str, cname: str,
+                         method: str, hop: int = 0) -> Optional[str]:
+        """Resolve a method against a class, then one hop of bases."""
+        idx = self._indexes.get(rel)
+        if idx is None or cname not in idx.classes:
+            return None
+        q = idx.classes[cname].get(method)
+        if q:
+            return q
+        if hop >= 1:
+            return None
+        for base in idx.class_bases.get(cname, ()):
+            t = self._resolve_class_name(idx, base)
+            if t:
+                q = self._method_in_class(t[0], t[1], method, hop + 1)
+                if q:
+                    return q
+        return None
+
+    def _resolve_call(self, idx: _ModuleIndex, fn: FuncNode,
+                      call: ast.Call,
+                      local_types: Dict[str, Tuple[str, str]],
+                      self_attr_types: Dict[str, Tuple[str, str]],
+                      ) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in idx.functions:
+                return idx.functions[f.id]
+            imp = idx.from_imports.get(f.id)
+            if imp:
+                other = self._indexes.get(imp[0])
+                if other:
+                    return other.functions.get(imp[1])
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        base = f.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and fn.cls:
+                return self._method_in_class(fn.rel, fn.cls, f.attr)
+            mod_rel = idx.import_alias.get(base.id)
+            if mod_rel:
+                other = self._indexes.get(mod_rel)
+                if other:
+                    return other.functions.get(f.attr)
+            t = local_types.get(base.id)
+            if t:
+                return self._method_in_class(t[0], t[1], f.attr)
+            return None
+        # ``self.attr.m()`` through an __init__-bound attribute type.
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self":
+            t = self_attr_types.get(base.attr)
+            if t:
+                return self._method_in_class(t[0], t[1], f.attr)
+        return None
+
+    # -- context propagation --
+
+    def _propagate_coroutine_contexts(self) -> None:
+        roots = [q for q, fn in self.functions.items() if fn.is_async]
+        for root in roots:
+            frontier = [root]
+            seen: Set[str] = {root}
+            while frontier:
+                q = frontier.pop()
+                for callee in self.edges.get(q, ()):
+                    if callee in seen:
+                        continue
+                    seen.add(callee)
+                    self.coroutine_roots.setdefault(callee, set()).add(root)
+                    frontier.append(callee)
+
+
+def walk_excluding_nested_defs(root: ast.AST) -> Iterable[ast.AST]:
+    """``ast.walk`` minus nested function/lambda bodies: a nested def or
+    lambda executes in its own context (callback, thread target, spawned
+    task, ``run_in_executor(None, lambda: ...)``) — its statements must
+    not be attributed to the enclosing function.  The root itself is
+    always yielded, even when it is a def."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+        yield node

@@ -243,6 +243,12 @@ def run_passes(ctx: Context, passes: Sequence[Pass],
     suppressed = 0
     base_fps = baseline.fingerprints() if baseline else set()
     used_fps: Set[str] = set()
+    if only_rules:
+        # Don't run passes none of whose rules can match: a scoped
+        # `--rules HDR` loop must not pay for the call-graph passes.
+        passes = [p for p in passes
+                  if any(rule == r or rule.startswith(r)
+                         for rule in p.rules for r in only_rules)]
     for p in passes:
         for f in p.run(ctx):
             if only_rules and not any(

@@ -8,10 +8,14 @@ from llm_d_tpu.analysis.core import Pass
 from llm_d_tpu.analysis.passes.async_blocking import AsyncBlockingPass
 from llm_d_tpu.analysis.passes.dockerfile import DockerfilePass
 from llm_d_tpu.analysis.passes.envvars import EnvVarsPass
+from llm_d_tpu.analysis.passes.faultpoints import FaultPointsPass
 from llm_d_tpu.analysis.passes.headers import HeadersPass
 from llm_d_tpu.analysis.passes.jit_hygiene import JitHygienePass
 from llm_d_tpu.analysis.passes.metrics_registry import MetricsPass
+from llm_d_tpu.analysis.passes.pair import PairPass
 from llm_d_tpu.analysis.passes.pallas_invariants import PallasPass
+from llm_d_tpu.analysis.passes.race import RacePass
+from llm_d_tpu.analysis.passes.task import TaskPass
 
 
 def all_passes() -> List[Pass]:
@@ -21,6 +25,10 @@ def all_passes() -> List[Pass]:
         EnvVarsPass(),
         JitHygienePass(),
         AsyncBlockingPass(),
+        RacePass(),
+        TaskPass(),
+        PairPass(),
+        FaultPointsPass(),
         PallasPass(),
         DockerfilePass(),
     ]

@@ -3,17 +3,23 @@
 One blocked event loop stalls EVERY request on that component (the
 gateway, the sidecar, the API server...), so the p99 story of the whole
 stack hinges on nothing synchronous sneaking into a coroutine.  Scope is
-every module that defines an ``async def`` (the stack's ten async
-modules today).
+AUTO-DISCOVERED: every module that defines an ``async def`` gets the
+lexical rules, and — via the project call graph
+(:mod:`llm_d_tpu.analysis.callgraph`) — ASYNC001 follows coroutines into
+sync helpers in ANY module, so a blocking call two modules away from the
+nearest ``async def`` is still caught.
 
   ASYNC001  blocking call (``time.sleep``, sync HTTP/urllib/requests,
-            subprocess, ``os.system``) lexically inside an ``async def``
-            — including nested sync helpers, which still run on the loop
-            when the coroutine calls them.
+            subprocess, ``os.system``) on a coroutine path: lexically
+            inside an ``async def`` (including nested sync helpers), OR
+            in a sync function the call graph proves reachable from a
+            coroutine — the message then names the async root.
   ASYNC002  a (threading) lock held across ``await``: everything else on
             the loop that touches the lock now deadlocks or serializes
             behind a suspended coroutine.  ``async with`` is exempt
-            (asyncio primitives are loop-aware).
+            (asyncio primitives are loop-aware).  The interprocedural
+            upgrade (lock held across a transitively-reached blocking
+            call) is RACE002.
   ASYNC003  ``time.sleep`` anywhere else in an async module — sync
             helpers in such modules get called from coroutines sooner or
             later (the faultinject latency rule was exactly this bug);
@@ -27,6 +33,8 @@ import ast
 import re
 from typing import List, Set, Tuple
 
+from llm_d_tpu.analysis.callgraph import (CallGraph,
+                                          walk_excluding_nested_defs)
 from llm_d_tpu.analysis.core import Context, Finding, Pass
 
 _BLOCKING_ATTR_CALLS = {
@@ -61,6 +69,22 @@ def _call_label(node: ast.Call) -> str:
     return ""
 
 
+# Lock-expression heuristic shared with the RACE pass: 'lock' as a
+# word-start, so 'block' / '_block_pool' (ubiquitous in this KV-block
+# codebase) never matches; asyncio primitives are loop-aware and exempt.
+_LOCKISH_RE = re.compile(r"(?<![a-z])lock")
+
+
+def _is_lockish(expr: ast.AST):
+    try:
+        text = ast.unparse(expr)
+    except Exception:
+        return None
+    if _LOCKISH_RE.search(text.lower()) and "asyncio" not in text:
+        return text
+    return None
+
+
 def _is_time_sleep(node: ast.Call) -> bool:
     f = node.func
     return (isinstance(f, ast.Attribute) and f.attr == "sleep"
@@ -70,7 +94,8 @@ def _is_time_sleep(node: ast.Call) -> bool:
 class AsyncBlockingPass(Pass):
     name = "async"
     rules = {
-        "ASYNC001": "blocking call inside an async def",
+        "ASYNC001": ("blocking call inside an async def or in a sync "
+                     "helper the call graph proves coroutine-reachable"),
         "ASYNC002": "threading lock held across await",
         "ASYNC003": ("time.sleep in an async module outside async def — "
                      "guard for a running loop or provide an async "
@@ -79,6 +104,30 @@ class AsyncBlockingPass(Pass):
 
     def run(self, ctx: Context) -> List[Finding]:
         findings: List[Finding] = []
+        # Interprocedural ASYNC001 first: blocking calls in SYNC functions
+        # reachable from a coroutine — any module, async defs or not.
+        interproc_lines: Set[Tuple[str, int]] = set()
+        graph = CallGraph.build(ctx)
+        for q, fn in graph.functions.items():
+            if fn.is_async or not graph.is_coroutine_context(q):
+                continue
+            root = sorted(graph.roots_of(q))[0]
+            root_node = graph.functions.get(root)
+            root_label = root_node.label if root_node else root
+            for node in walk_excluding_nested_defs(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = _call_label(node)
+                key = (fn.rel, node.lineno)
+                if label and key not in interproc_lines:
+                    interproc_lines.add(key)
+                    findings.append(Finding(
+                        "ASYNC001", fn.rel, node.lineno,
+                        f"blocking {label} in sync {fn.name!r}, which "
+                        f"runs on the event loop when coroutine "
+                        f"{root_label} calls it — use the asyncio "
+                        f"equivalent or an executor"))
+
         for rel in list(ctx.package_files) + list(ctx.script_files):
             src = ctx.source(rel)
             tree = src.tree
@@ -96,6 +145,10 @@ class AsyncBlockingPass(Pass):
                         in_async.add((node.lineno, node.col_offset))
                         label = _call_label(node)
                         key = ("ASYNC001", node.lineno)
+                        # (No interproc_lines dedupe needed here: the
+                        # interproc walk covers only SYNC top-level
+                        # functions, the lexical one only async-def
+                        # subtrees — the line sets cannot overlap.)
                         if label and key not in seen:
                             seen.add(key)
                             findings.append(Finding(
@@ -106,10 +159,13 @@ class AsyncBlockingPass(Pass):
                     if isinstance(node, ast.With):
                         findings.extend(self._lock_across_await(
                             rel, fn.name, node, seen))
-            # ASYNC003: time.sleep in the module's sync remainder.
+            # ASYNC003: time.sleep in the module's sync remainder (lines
+            # already flagged interprocedurally carry the sharper
+            # ASYNC001 message naming the coroutine root).
             for node in ast.walk(tree):
                 if isinstance(node, ast.Call) and _is_time_sleep(node) \
-                        and (node.lineno, node.col_offset) not in in_async:
+                        and (node.lineno, node.col_offset) not in in_async \
+                        and (rel, node.lineno) not in interproc_lines:
                     findings.append(Finding(
                         "ASYNC003", rel, node.lineno,
                         "time.sleep in an async module; a coroutine "
@@ -124,14 +180,8 @@ class AsyncBlockingPass(Pass):
         if not has_await:
             return []
         for item in node.items:
-            try:
-                expr = ast.unparse(item.context_expr)
-            except Exception:
-                continue
-            # (?<![a-z]) so 'block'/'_block_pool' (ubiquitous in this
-            # KV-block-centric codebase) never reads as a lock.
-            if re.search(r"(?<![a-z])lock", expr.lower()) \
-                    and "asyncio" not in expr:
+            expr = _is_lockish(item.context_expr)
+            if expr is not None:
                 key = ("ASYNC002", node.lineno)
                 if key in seen:
                     return []

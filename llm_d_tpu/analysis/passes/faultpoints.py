@@ -1,0 +1,142 @@
+"""FAULT: fault-point coverage cross-check (docs table + catalog + tests).
+
+A fault point nobody can find in docs/resilience.md is a chaos knob no
+operator will ever turn, and one no test references is a failure path no
+CI run has ever walked — the PAL003 parity-coverage doctrine applied to
+the failure surface.  The rules cross-check three sources of truth:
+
+  - call sites: ``get_injector().check("point", ...)`` /
+    ``acheck("point", ...)`` string literals in package + script code;
+  - the ``FAULT_POINTS`` catalog tuple in ``utils/faultinject.py``
+    (spec-parse warnings key off it);
+  - the fault-point table in ``docs/resilience.md`` (rows whose first
+    cell is a backticked point name).
+
+  FAULT001  a point checked in code with no docs/resilience.md table row
+            (operators cannot discover the knob).
+  FAULT002  a point checked in code that no test references (the failure
+            path has never been exercised).
+  FAULT003  catalog drift: a checked point missing from ``FAULT_POINTS``
+            (spec parsing will warn 'unknown point' on a real rule), or
+            a catalog entry no call site backs (stale, documents a hook
+            that no longer exists).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+FAULTINJECT_MODULE = "llm_d_tpu/utils/faultinject.py"
+RESILIENCE_DOC = "docs/resilience.md"
+
+# A docs table row whose first cell is a backticked dotted point name.
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z_]+\.[a-z_]+)`\s*\|", re.MULTILINE)
+
+
+def _call_sites(ctx: Context) -> Dict[str, Tuple[str, int]]:
+    """point -> first (rel, line) calling check()/acheck() with it."""
+    sites: Dict[str, Tuple[str, int]] = {}
+    for rel in list(ctx.package_files) + list(ctx.script_files):
+        if rel == FAULTINJECT_MODULE:
+            continue                      # the implementation itself
+        src = ctx.source(rel)
+        tree = src.tree
+        if tree is None or "injector" not in src.text:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("check", "acheck")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            try:
+                recv = ast.unparse(node.func.value)
+            except Exception:
+                continue
+            if "injector" not in recv and "inj" != recv:
+                continue                  # some other object's .check()
+            point = node.args[0].value
+            sites.setdefault(point, (rel, node.lineno))
+    return sites
+
+
+def _catalog(ctx: Context) -> Dict[str, int]:
+    """point -> line of its FAULT_POINTS entry (so stale-row findings
+    anchor somewhere an inline suppression can reach)."""
+    src = ctx.source(FAULTINJECT_MODULE) \
+        if FAULTINJECT_MODULE in ctx.package_files else None
+    if src is None or src.tree is None:
+        return {}
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+                        for t in node.targets) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value: e.lineno for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return {}
+
+
+class FaultPointsPass(Pass):
+    name = "fault"
+    rules = {
+        "FAULT001": ("fault point checked in code with no "
+                     "docs/resilience.md table row"),
+        "FAULT002": "fault point no test references (never exercised)",
+        "FAULT003": ("FAULT_POINTS catalog drift vs. actual "
+                     "check()/acheck() call sites"),
+    }
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        sites = _call_sites(ctx)
+        catalog = _catalog(ctx)
+        doc_text = ctx.read_text(RESILIENCE_DOC) or ""
+        documented = set(_DOC_ROW_RE.findall(doc_text))
+        # Coverage = the point appears in a STRING LITERAL of a test
+        # (a check("point") call, an LLMD_FAULTS spec, an assertion) —
+        # comments, docstrings and longer identifiers that merely
+        # contain the dotted name certify nothing.
+        test_literals: List[str] = []
+        for rel in ctx.test_files:
+            src = ctx.source(rel)
+            if src.tree is None:
+                continue
+            doc_lines = src.docstring_lines
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.lineno not in doc_lines:
+                    test_literals.append(node.value)
+        for point, (rel, line) in sorted(sites.items()):
+            if point not in documented:
+                findings.append(Finding(
+                    "FAULT001", rel, line,
+                    f"fault point {point!r} has no row in the "
+                    f"{RESILIENCE_DOC} fault-point table — operators "
+                    f"cannot discover the knob"))
+            if not any(point in lit for lit in test_literals):
+                findings.append(Finding(
+                    "FAULT002", rel, line,
+                    f"fault point {point!r} is referenced by no test — "
+                    f"its failure path has never been exercised; add a "
+                    f"chaos/fault test that installs a rule on it"))
+            if catalog and point not in catalog:
+                findings.append(Finding(
+                    "FAULT003", rel, line,
+                    f"fault point {point!r} missing from the FAULT_POINTS "
+                    f"catalog in {FAULTINJECT_MODULE} — LLMD_FAULTS spec "
+                    f"parsing will warn 'unknown point' on a real rule"))
+        for point in sorted(set(catalog) - set(sites)):
+            findings.append(Finding(
+                "FAULT003", FAULTINJECT_MODULE, catalog[point],
+                f"FAULT_POINTS entry {point!r} has no check()/acheck() "
+                f"call site — stale catalog row"))
+        return findings

@@ -1,0 +1,380 @@
+"""PAIR: resource-lifecycle effect pairing on all paths, exception paths
+included.
+
+PR 9's satellite fix was exactly this bug class found by hand: a dead DP
+worker's streaming slot stayed counted because the release ran on the
+success path only.  Every counter and pin in the serving stack has the
+same shape — an acquire effect whose release must run no matter which
+statement in between raises.  The rules:
+
+  PAIR001  a counter incremented and decremented in the same function
+           (``self._inflight += 1`` / ``worker["inflight"] -= 1`` /
+           ``self._queued``...) where a raising-capable statement (any
+           call or await) sits between the increment and the decrement
+           and the decrement is NOT inside a ``finally`` whose ``try``
+           protects that whole span.  Statements between the increment
+           and the protecting ``try`` are unprotected too — put the
+           increment immediately before the ``try``.
+  PAIR002  configured acquire/release call families (KV ``take_block``
+           -> ``_release``/``free``/``release_tail``; stream-journal
+           ``mark_break`` -> ``take_recoveries``): after the acquire, a
+           release must be reachable in the function or one call hop —
+           and for ownership-critical families, reachable on RAISE paths
+           (``finally``/``except``) when anything between can throw.
+  PAIR003  circuit-breaker accounting bias: a function recording
+           ``record_success`` must also record ``record_failure`` (in
+           the function or one call hop) — success-only recording can
+           never trip a breaker, failure paths silently stop counting.
+
+Project-scoped pairs (producer pins: a ``pinned_transfers[...] = req``
+store demands a ``pinned_transfers.pop`` release *somewhere*) are checked
+globally — the acquire and release legitimately live in different
+functions, but a tree with the release side deleted is a leak.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from llm_d_tpu.analysis.callgraph import (CallGraph, FuncNode,
+                                          walk_excluding_nested_defs)
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+
+@dataclasses.dataclass(frozen=True)
+class CallFamily:
+    label: str                 # human name for messages
+    acquire: str               # method/function name whose call acquires
+    releases: Tuple[str, ...]  # names whose call releases
+    critical: bool             # must the release survive raise paths?
+
+
+CALL_FAMILIES = (
+    CallFamily("KV block", "take_block",
+               ("_release", "free", "release_tail"), critical=True),
+    CallFamily("stream-journal recovery measurement", "mark_break",
+               ("take_recoveries",), critical=False),
+)
+
+# attr-store acquire -> call release, checked tree-wide (the pair spans
+# functions by design; only a missing release SIDE is a finding).
+PROJECT_PAIRS = (
+    ("pinned_transfers", "pop",
+     "producer-pin store with no pop/release anywhere"),
+)
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _counter_target(node: ast.AugAssign) -> Optional[str]:
+    """Normalized text of a +=1/-=1 target (attr or subscript)."""
+    if not (isinstance(node.value, ast.Constant) and node.value.value == 1):
+        return None
+    if not isinstance(node.op, (ast.Add, ast.Sub)):
+        return None
+    try:
+        return ast.unparse(node.target)
+    except Exception:
+        return None
+
+
+class PairPass(Pass):
+    name = "pair"
+    rules = {
+        "PAIR001": ("counter increment whose decrement can be skipped by "
+                    "an exception (release not under finally)"),
+        "PAIR002": ("resource acquire without a reachable (exception-"
+                    "safe) release"),
+        "PAIR003": ("breaker record_success without record_failure — "
+                    "one-sided accounting"),
+    }
+
+    def run(self, ctx: Context) -> List[Finding]:
+        graph = CallGraph.build(ctx)
+        findings: List[Finding] = []
+        for q, fn in graph.functions.items():
+            findings.extend(self._pair001(fn))
+            findings.extend(self._pair002(graph, fn))
+            findings.extend(self._pair003(graph, fn))
+        findings.extend(self._project_pairs(ctx, graph))
+        return findings
+
+    # ---------- shared walk machinery ----------
+
+    @staticmethod
+    def _finally_spans(fn_node: ast.AST) -> List[Tuple[range, range]]:
+        """(try-body span, finally span) for every try/finally."""
+        spans = []
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Try) and node.finalbody:
+                body_end = max(s.end_lineno or s.lineno
+                               for s in (node.body + sum(
+                                   [h.body for h in node.handlers], [])
+                                   + node.orelse))
+                fin_start = node.finalbody[0].lineno
+                fin_end = max(s.end_lineno or s.lineno
+                              for s in node.finalbody)
+                spans.append((range(node.lineno, body_end + 1),
+                              range(fin_start, fin_end + 1)))
+        return spans
+
+    @staticmethod
+    def _broad_handler_spans(fn_node: ast.AST, in_coroutine: bool
+                             ) -> List[Tuple[range, range]]:
+        """(try-body span, handler span) for every try/except whose
+        handler catches ALL raise paths — bare ``except``,
+        ``BaseException``, or (in sync code only) ``Exception``.  A
+        narrow ``except ValueError`` still leaks on every other type;
+        and in a coroutine, cancellation raises CancelledError (a
+        BaseException) at the ``await``, sailing past ``except
+        Exception`` — only a finally/BaseException covers it there."""
+        broad = {"BaseException"} if in_coroutine \
+            else {"Exception", "BaseException"}
+        spans = []
+        for node in ast.walk(fn_node):
+            if not (isinstance(node, ast.Try) and node.handlers):
+                continue
+            body_end = max(s.end_lineno or s.lineno for s in node.body)
+            for h in node.handlers:
+                types = [h.type] if not isinstance(h.type, ast.Tuple) \
+                    else list(h.type.elts)
+                names = {t.attr if isinstance(t, ast.Attribute)
+                         else getattr(t, "id", None) for t in types}
+                if h.type is not None and not names & broad:
+                    continue
+                h_end = max(s.end_lineno or s.lineno for s in h.body)
+                spans.append((range(node.lineno, body_end + 1),
+                              range(h.lineno, h_end + 1)))
+        return spans
+
+    @staticmethod
+    def _sibling_branch_lines(fn_node: ast.AST, anchor: int) -> Set[int]:
+        """Lines that can never execute on the same path as ``anchor``:
+        the other arm of every ``if`` whose one arm contains it."""
+        out: Set[int] = set()
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.If):
+                continue
+            arms = []
+            for block in (node.body, node.orelse):
+                lines: Set[int] = set()
+                for s in block:
+                    lines.update(range(s.lineno,
+                                       (s.end_lineno or s.lineno) + 1))
+                arms.append(lines)
+            if anchor in arms[0]:
+                out |= arms[1]
+            elif anchor in arms[1]:
+                out |= arms[0]
+        return out
+
+    def _raising_between(self, fn_node: ast.AST, lo: int, hi: int,
+                         skip_lines: Set[int]) -> bool:
+        """Any raising-capable statement on lines (lo, hi) exclusive that
+        can share a path with the acquire at ``lo`` (sibling if-branches
+        are line-between but never path-between)."""
+        excluded = self._sibling_branch_lines(fn_node, lo)
+        for node in walk_excluding_nested_defs(fn_node):
+            if isinstance(node, (ast.Call, ast.Await, ast.Raise)) \
+                    and lo < node.lineno < hi \
+                    and node.lineno not in skip_lines \
+                    and node.lineno not in excluded:
+                return True
+        return False
+
+    # ---------- PAIR001 ----------
+
+    def _pair001(self, fn: FuncNode) -> List[Finding]:
+        # Nested defs excluded throughout: a decrement living in a
+        # done-callback (the TASK001-recommended pattern) is an
+        # ownership handoff, not an in-function pair.
+        incs: Dict[str, List[int]] = {}
+        decs: Dict[str, List[int]] = {}
+        for node in walk_excluding_nested_defs(fn.node):
+            if isinstance(node, ast.AugAssign):
+                tgt = _counter_target(node)
+                if tgt is None:
+                    continue
+                (incs if isinstance(node.op, ast.Add) else decs) \
+                    .setdefault(tgt, []).append(node.lineno)
+        if not incs or not decs:
+            return []
+        fin_spans = self._finally_spans(fn.node)
+        findings: List[Finding] = []
+        for tgt, inc_lines in sorted(incs.items()):
+            dec_lines = decs.get(tgt)
+            if not dec_lines:
+                continue            # ownership handoff: released elsewhere
+            for inc in inc_lines:
+                if any(inc in fin for _body, fin in fin_spans):
+                    continue        # compensating dec inside a finally
+                ok = False
+                for dec in dec_lines:
+                    if dec <= inc:
+                        continue    # a dec above the inc settles nothing
+                    protecting = [
+                        (body, fin) for body, fin in fin_spans
+                        if dec in fin]
+                    if protecting:
+                        body, _fin = protecting[0]
+                        # Protected if the inc sits inside the guarded
+                        # try itself, or immediately before it (nothing
+                        # raising between the inc and the try line).
+                        if inc in body or (
+                                inc < body.start
+                                and not self._raising_between(
+                                    fn.node, inc, body.start, set())):
+                            ok = True
+                            break
+                    else:
+                        if not self._raising_between(
+                                fn.node, inc, dec, {dec}):
+                            ok = True
+                            break
+                if not ok:
+                    findings.append(Finding(
+                        "PAIR001", fn.rel, inc,
+                        f"{tgt} += 1 in "
+                        f"{(fn.cls + '.') if fn.cls else ''}{fn.name} but "
+                        f"the -= 1 can be skipped by an exception between "
+                        f"them — move the increment directly before a "
+                        f"try whose finally decrements"))
+        return findings
+
+    # ---------- PAIR002 ----------
+
+    def _pair002(self, graph: CallGraph,
+                 fn: FuncNode) -> List[Finding]:
+        findings: List[Finding] = []
+        for fam in CALL_FAMILIES:
+            if fn.name == fam.acquire or fn.name in fam.releases:
+                continue            # the implementation itself
+            acquires = [n for n in walk_excluding_nested_defs(fn.node)
+                        if isinstance(n, ast.Call)
+                        and _call_name(n) == fam.acquire]
+            if not acquires:
+                continue
+            release_lines = self._release_lines(graph, fn, fam)
+            fin_spans = self._finally_spans(fn.node)
+            hdl_spans = self._broad_handler_spans(fn.node, fn.is_async)
+            for acq in acquires:
+                after = [ln for ln in release_lines if ln > acq.lineno]
+                if not after:
+                    findings.append(Finding(
+                        "PAIR002", fn.rel, acq.lineno,
+                        f"{fam.label}: {fam.acquire}() acquired but no "
+                        f"release ({'/'.join(fam.releases)}) is reachable "
+                        f"afterwards in this function or its direct "
+                        f"callees — leaked on every path"))
+                    continue
+                if not fam.critical:
+                    continue
+                protected = any(
+                    (acq.lineno in body or not self._raising_between(
+                        fn.node, acq.lineno, body.start, set()))
+                    and any(ln in guard for ln in after)
+                    for spans in (fin_spans, hdl_spans)
+                    for body, guard in spans)
+                first = min(after)
+                if not protected and self._raising_between(
+                        fn.node, acq.lineno, first, {first}):
+                    findings.append(Finding(
+                        "PAIR002", fn.rel, acq.lineno,
+                        f"{fam.label}: release can be skipped by an "
+                        f"exception between {fam.acquire}() and the "
+                        f"release at line {first} — release in a "
+                        f"finally/except or make the span raise-free"))
+        return findings
+
+    def _release_lines(self, graph: CallGraph, fn: FuncNode,
+                       fam: CallFamily) -> List[int]:
+        """Lines in ``fn`` where a release happens: direct release calls,
+        plus call sites of one-hop callees that themselves release."""
+        lines: List[int] = []
+        releasing_callees: Set[str] = set()
+        for callee_q in graph.edges.get(fn.qname, ()):
+            callee = graph.functions.get(callee_q)
+            if callee is None:
+                continue
+            for n in ast.walk(callee.node):
+                if isinstance(n, ast.Call) \
+                        and _call_name(n) in fam.releases:
+                    releasing_callees.add(callee.name)
+                    break
+        for n in walk_excluding_nested_defs(fn.node):
+            if isinstance(n, ast.Call):
+                name = _call_name(n)
+                if name in fam.releases or name in releasing_callees:
+                    lines.append(n.lineno)
+        return lines
+
+    # ---------- PAIR003 ----------
+
+    def _pair003(self, graph: CallGraph, fn: FuncNode) -> List[Finding]:
+        if fn.name in ("record_success", "record_failure"):
+            return []
+        succ = [n.lineno for n in walk_excluding_nested_defs(fn.node)
+                if isinstance(n, ast.Call)
+                and _call_name(n) == "record_success"]
+        if not succ:
+            return []
+        names = {"record_failure"}
+        for callee_q in graph.edges.get(fn.qname, ()):
+            callee = graph.functions.get(callee_q)
+            if callee is None:
+                continue
+            if any(isinstance(n, ast.Call)
+                   and _call_name(n) == "record_failure"
+                   for n in ast.walk(callee.node)):
+                names.add(callee.name)
+        has_failure = any(isinstance(n, ast.Call) and _call_name(n) in names
+                          and _call_name(n) != "record_success"
+                          for n in ast.walk(fn.node))
+        if has_failure:
+            return []
+        return [Finding(
+            "PAIR003", fn.rel, succ[0],
+            f"{(fn.cls + '.') if fn.cls else ''}{fn.name} records breaker "
+            f"successes but never failures — the breaker can close but "
+            f"never trip from this path; record_failure on the error "
+            f"paths too")]
+
+    # ---------- project-scoped pairs ----------
+
+    def _project_pairs(self, ctx: Context,
+                       graph: CallGraph) -> List[Finding]:
+        findings: List[Finding] = []
+        for attr, release, msg in PROJECT_PAIRS:
+            store_site: Optional[Tuple[str, int]] = None
+            released = False
+            for rel in list(ctx.package_files) + list(ctx.script_files):
+                tree = ctx.source(rel).tree
+                if tree is None:
+                    continue
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Assign):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Subscript) \
+                                    and isinstance(tgt.value, ast.Attribute) \
+                                    and tgt.value.attr == attr \
+                                    and store_site is None:
+                                store_site = (rel, node.lineno)
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == release \
+                            and isinstance(node.func.value, ast.Attribute) \
+                            and node.func.value.attr == attr:
+                        released = True
+            if store_site is not None and not released:
+                findings.append(Finding(
+                    "PAIR002", store_site[0], store_site[1], msg))
+        return findings

@@ -1,0 +1,406 @@
+"""RACE: interprocedural async-race analysis over the call graph.
+
+asyncio code races only at suspension points: between two ``await``\\ s a
+coroutine owns the loop outright, so every data race in this stack is a
+check-then-act (or lost update) that straddles an ``await`` — exactly the
+window the scheduler/engine-step refactors keep adding around DP slot
+accounting, breaker state and stream journals.  Thread code adds the
+classic second failure mode: a threading lock shared with the loop, held
+around something slow.  Three rules, all built on
+:mod:`llm_d_tpu.analysis.callgraph`:
+
+  RACE001  a shared mutable ``self.X`` is accessed, the coroutine
+           suspends (an ``await`` that can fall through), and ``self.X``
+           is written afterwards — an interleaving window in which any
+           concurrent coroutine (another request, or another writer
+           method) can mutate the attribute between the check and the
+           act.  Accesses under a common ``with <lock>`` /
+           ``async with <lock>`` guard are exempt (the guard IS the
+           fix); ``await``\\ s whose block unconditionally terminates
+           (``await ...; return``) open no window and are ignored.
+  RACE002  a threading lock held while the body *transitively* reaches a
+           blocking primitive (``time.sleep``, sync HTTP, subprocess)
+           through resolved call edges — the interprocedural upgrade of
+           lexical ASYNC002, which only sees an ``await`` directly under
+           the ``with``.  Scope: functions in coroutine context (one
+           blocked lock on the loop serializes every request behind it).
+  RACE003  lock-acquisition ordering: acquiring lock B (directly or
+           through resolved calls) while holding lock A adds edge A->B;
+           a cycle in that graph is a deadlock waiting for the right
+           interleaving.  Locks are identified by normalized expression
+           (``self._lock`` -> ``Class._lock``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from llm_d_tpu.analysis.callgraph import (CallGraph, FuncNode,
+                                          walk_excluding_nested_defs)
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+from llm_d_tpu.analysis.passes.async_blocking import _call_label, _is_lockish
+
+
+def _terminates(stmt: ast.stmt) -> bool:
+    return isinstance(stmt, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _contains_await(node: ast.AST) -> bool:
+    # A nested def's awaits run when IT runs, not here — skip its
+    # subtree (but keep searching the rest of the statement).
+    return any(isinstance(sub, ast.Await)
+               for sub in walk_excluding_nested_defs(node))
+
+
+def _blocks_of(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    out = []
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if block:
+            out.append(list(block))
+    for h in getattr(stmt, "handlers", []) or []:
+        out.append(list(h.body))
+    return out
+
+
+def _await_falls_through(stmt: ast.stmt) -> bool:
+    """Does executing this statement possibly suspend AND then continue
+    to the statements after it?  ``await x(); return`` suspends but never
+    falls through — it opens no interleaving window for later code."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Return, ast.Raise)):
+        return False
+    blocks = _blocks_of(stmt)
+    if not blocks:
+        return _contains_await(stmt)
+    is_loop = isinstance(stmt, (ast.While, ast.For, ast.AsyncFor))
+    for block in blocks:
+        if not any(_contains_await(s) for s in block):
+            continue
+        last = block[-1]
+        if is_loop and isinstance(last, (ast.Break, ast.Continue)):
+            # break lands exactly on the statement after the loop, and
+            # continue re-runs it until normal exit — either way the
+            # LOOP falls through after having suspended.
+            return True
+        if not _terminates(last):
+            return True
+    return False
+
+
+def _self_attr_accesses(stmt: ast.stmt) -> Tuple[Set[str], Set[str]]:
+    """(reads, writes) of ``self.X`` anywhere in the statement (nested
+    defs excluded — they execute in their own context)."""
+    reads: Set[str] = set()
+    writes: Set[str] = set()
+    for node in walk_excluding_nested_defs(stmt):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            if isinstance(node.ctx, ast.Load):
+                reads.add(node.attr)
+            else:
+                writes.add(node.attr)
+    return reads, writes
+
+
+class RacePass(Pass):
+    name = "race"
+    rules = {
+        "RACE001": ("shared self.X accessed, then awaited, then written — "
+                    "unguarded interleaving window"),
+        "RACE002": ("threading lock held across a (transitively reached) "
+                    "blocking call in coroutine context"),
+        "RACE003": "lock-acquisition ordering cycle (potential deadlock)",
+    }
+
+    def run(self, ctx: Context) -> List[Finding]:
+        graph = CallGraph.build(ctx)
+        findings: List[Finding] = []
+        writer_index = self._attr_writers(graph)
+        for q, fn in graph.functions.items():
+            if fn.is_async and fn.cls:
+                findings.extend(self._race001(graph, fn, writer_index))
+            if graph.is_coroutine_context(q):
+                findings.extend(self._race002(graph, fn))
+        findings.extend(self._race003(graph))
+        return findings
+
+    # ---------- RACE001 ----------
+
+    @staticmethod
+    def _attr_writers(graph: CallGraph) -> Dict[Tuple[str, str, str],
+                                                Set[str]]:
+        """(rel, class, attr) -> coroutine-context methods writing it."""
+        out: Dict[Tuple[str, str, str], Set[str]] = {}
+        for q, fn in graph.functions.items():
+            if not fn.cls or not graph.is_coroutine_context(q):
+                continue
+            for node in walk_excluding_nested_defs(fn.node):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and not isinstance(node.ctx, ast.Load):
+                    out.setdefault((fn.rel, fn.cls, node.attr),
+                                   set()).add(fn.name)
+        return out
+
+    def _race001(self, graph: CallGraph, fn: FuncNode,
+                 writer_index: Dict[Tuple[str, str, str], Set[str]]
+                 ) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int]] = set()
+
+        def scan(stmts: Sequence[ast.stmt],
+                 pre_reads: Tuple[str, ...] = ()) -> None:
+            # Per-level event stream; windows are only claimed between
+            # DISTINCT statements of one straight-line block, so branch
+            # statements can't fabricate an impossible path.  pre_reads
+            # seeds the enclosing if/while TEST's reads (index -1): the
+            # lazy-init shape ``if self.x is None: self.x = await f()``
+            # checks at the test and acts inside the branch.
+            accessed_at: Dict[str, int] = {a: -1 for a in pre_reads}
+            suspend_at: Optional[int] = None
+            for i, stmt in enumerate(stmts):
+                if isinstance(stmt, (ast.With, ast.AsyncWith)) \
+                        and any(_is_lockish(it.context_expr)
+                                for it in stmt.items):
+                    # Consistent-guard exemption: accesses INSIDE a
+                    # lock-guarded block are the fix RACE001 asks for —
+                    # but the block still suspends, so accesses straddling
+                    # it from OUTSIDE keep their window.
+                    if _await_falls_through(stmt):
+                        suspend_at = i
+                    continue
+                reads, writes = _self_attr_accesses(stmt)
+                # A store whose RHS awaits suspends BEFORE the assignment
+                # lands: ``self.x = await f()`` closes a window opened by
+                # any earlier read of self.x in this block (or the test).
+                value_awaits = isinstance(
+                    stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)) \
+                    and getattr(stmt, "value", None) is not None \
+                    and _contains_await(stmt.value)
+                eff_suspend = i if value_awaits else suspend_at
+                test = getattr(stmt, "test", None)
+                own_test_reads = _self_attr_accesses(test)[0] \
+                    if test is not None else set()
+                if eff_suspend is not None:
+                    for attr in writes:
+                        if attr in own_test_reads:
+                            # Double-checked idiom: this statement's own
+                            # test RE-reads the attr right before the
+                            # write — the rule's recommended fix.
+                            continue
+                        j = accessed_at.get(attr)
+                        if j is not None and j < eff_suspend:
+                            key = (attr, stmt.lineno)
+                            if key not in seen:
+                                seen.add(key)
+                                others = writer_index.get(
+                                    (fn.rel, fn.cls or "", attr), set())
+                                concurrent = sorted(others - {fn.name}) \
+                                    or [f"{fn.name} (re-entered)"]
+                                findings.append(Finding(
+                                    "RACE001", fn.rel, stmt.lineno,
+                                    f"self.{attr} checked before the await "
+                                    f"and written after it in "
+                                    f"{fn.cls}.{fn.name} — a concurrent "
+                                    f"writer ({', '.join(concurrent)}) can "
+                                    f"interleave in the window; guard both "
+                                    f"sides with one lock or re-check "
+                                    f"after the await"))
+                for attr in reads | writes:
+                    # Record the LATEST access: a re-read after the
+                    # suspension (the sequential double-check) refreshes
+                    # the check, so later writes open no window.
+                    accessed_at[attr] = i
+                # Track the LATEST fall-through suspension: a handler
+                # whose first statement awaits must still have its later
+                # check-await-act windows claimed.
+                if _await_falls_through(stmt):
+                    suspend_at = i
+                for block in _blocks_of(stmt):
+                    scan(block, tuple(own_test_reads))
+
+        scan(fn.node.body)
+        return findings
+
+    # ---------- RACE002 ----------
+
+    def _race002(self, graph: CallGraph, fn: FuncNode) -> List[Finding]:
+        findings: List[Finding] = []
+        for stmt in walk_excluding_nested_defs(fn.node):
+            if not isinstance(stmt, ast.With):
+                continue
+            lock = next((_is_lockish(it.context_expr)
+                         for it in stmt.items
+                         if _is_lockish(it.context_expr)), None)
+            if lock is None:
+                continue
+            hit = self._transitive_blocking(graph, fn, stmt)
+            if hit is None:
+                continue
+            callee, label = hit
+            root = next(iter(sorted(graph.roots_of(fn.qname))), "")
+            root_name = root.split("::")[-1] if root else "?"
+            findings.append(Finding(
+                "RACE002", fn.rel, stmt.lineno,
+                f"lock {lock!r} held in {fn.label.split(' (')[0]!r} while "
+                f"{callee.label} calls blocking {label} — reachable from "
+                f"coroutine {root_name!r}; everything on the loop "
+                f"serializes behind the held lock"))
+        return findings
+
+    def _transitive_blocking(self, graph: CallGraph, fn: FuncNode,
+                             with_stmt: ast.With
+                             ) -> Optional[Tuple[FuncNode, str]]:
+        """A blocking call reached from the with-body through >=1 resolved
+        call edge (direct blocking calls in the body are lexical ASYNC001/
+        ASYNC002 territory and not re-reported here)."""
+        idx_calls: Set[str] = set()
+        for sub in walk_excluding_nested_defs(with_stmt):
+            if isinstance(sub, ast.Call):
+                callee = graph.resolve_call(fn.qname, sub)
+                if callee:
+                    idx_calls.add(callee)
+        frontier = set(idx_calls)
+        seen: Set[str] = set()
+        while frontier:
+            q = frontier.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            node = graph.functions.get(q)
+            if node is None:
+                continue
+            for sub in walk_excluding_nested_defs(node.node):
+                if isinstance(sub, ast.Call):
+                    label = _call_label(sub)
+                    if label:
+                        return node, label
+            frontier |= graph.edges.get(q, set())
+        return None
+
+    # ---------- RACE003 ----------
+
+    def _race003(self, graph: CallGraph) -> List[Finding]:
+        # lock id -> {acquired-while-held lock id -> example site}
+        order: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+        def lock_id(fn: FuncNode, text: str) -> str:
+            if text.startswith("self.") and fn.cls:
+                return f"{fn.cls}{text[4:]}"
+            return text
+
+        locks_in_memo: Dict[str, Set[str]] = {}
+
+        def locks_in(q: str, depth: int = 3) -> Set[str]:
+            """Lock expressions acquired by q or its callees (bounded).
+            Memoized per callee — the result is caller-independent, and
+            this runs once per resolved call under every with."""
+            hit = locks_in_memo.get(q)
+            if hit is not None:
+                return hit
+            out: Set[str] = set()
+            frontier, seen = {q}, set()
+            d = 0
+            while frontier and d <= depth:
+                nxt: Set[str] = set()
+                for cur in frontier:
+                    if cur in seen:
+                        continue
+                    seen.add(cur)
+                    node = graph.functions.get(cur)
+                    if node is None:
+                        continue
+                    for sub in walk_excluding_nested_defs(node.node):
+                        if isinstance(sub, (ast.With, ast.AsyncWith)):
+                            for it in sub.items:
+                                t = _is_lockish(it.context_expr)
+                                if t:
+                                    out.add(lock_id(node, t))
+                    nxt |= graph.edges.get(cur, set())
+                frontier = nxt
+                d += 1
+            locks_in_memo[q] = out
+            return out
+
+        for q, fn in graph.functions.items():
+            for stmt in walk_excluding_nested_defs(fn.node):
+                if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    continue
+                held = [_is_lockish(it.context_expr) for it in stmt.items]
+                held = [h for h in held if h]
+                if not held:
+                    continue
+                inner: Set[str] = set()
+                for sub in walk_excluding_nested_defs(stmt):
+                    if isinstance(sub, (ast.With, ast.AsyncWith)) \
+                            and sub is not stmt:
+                        for it in sub.items:
+                            t = _is_lockish(it.context_expr)
+                            if t:
+                                inner.add(lock_id(fn, t))
+                    if isinstance(sub, ast.Call):
+                        callee = graph.resolve_call(fn.qname, sub)
+                        if callee:
+                            inner |= locks_in(callee)
+                for h in held:
+                    hid = lock_id(fn, h)
+                    for acq in inner:
+                        if acq != hid:
+                            order.setdefault(hid, {}).setdefault(
+                                acq, (fn.rel, stmt.lineno))
+
+        # Cycle detection (DFS with colors) over the lock-order graph.
+        findings: List[Finding] = []
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(u: str) -> Optional[List[str]]:
+            color[u] = 1
+            stack.append(u)
+            for v in order.get(u, {}):
+                if color.get(v, 0) == 1:
+                    return stack[stack.index(v):] + [v]
+                if color.get(v, 0) == 0:
+                    cyc = dfs(v)
+                    if cyc:
+                        return cyc
+            stack.pop()
+            color[u] = 2
+            return None
+
+        # Iterate to a fixpoint: report a cycle, remove its closing edge,
+        # re-walk — so a second distinct cycle sharing nodes with the
+        # first (a->b->c->a AND a->b->a) is still found.  Bounded by the
+        # edge count: each round deletes one edge.
+        reported: Set[frozenset] = set()
+        for _round in range(sum(len(v) for v in order.values())):
+            cyc = None
+            for u in sorted(order):
+                if color.get(u, 0) == 0:
+                    cyc = dfs(u)
+                    if cyc:
+                        break
+            # dfs leaves stack/color mid-walk when it finds a cycle;
+            # reset unconditionally or stale gray marks make the next
+            # round's walk fabricate a path over non-edges.
+            stack.clear()
+            color.clear()
+            if not cyc:
+                break
+            rel, line = order[cyc[0]][cyc[1]]
+            order[cyc[-2]].pop(cyc[-1], None)
+            key = frozenset(cyc)
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.append(Finding(
+                "RACE003", rel, line,
+                f"lock-order cycle {' -> '.join(cyc)}: two paths "
+                f"acquire these locks in opposite orders — a "
+                f"deadlock under the right interleaving; pick one "
+                f"global order"))
+        return findings

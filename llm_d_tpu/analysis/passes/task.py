@@ -1,0 +1,222 @@
+"""TASK: asyncio task- and coroutine-lifecycle hygiene.
+
+An asyncio task whose handle is dropped is garbage-collectable mid-run
+(its work silently stops) and its exception is never observed (the
+failure vanishes into the "Task exception was never retrieved" log long
+after the cause).  A coroutine called without ``await`` never runs at
+all.  Both are one-character bugs the event loop will not surface in any
+test that doesn't force a GC or read stderr — so the checker does:
+
+  TASK001  fire-and-forget ``create_task`` / ``ensure_future``: the
+           returned handle is discarded, or bound to a local that is
+           never retained (no ``add_done_callback``, no ``await``, no
+           store into an attribute/container, no further use).  The
+           loop holds only a weak reference — hold one or register a
+           callback (the ``_bg_tasks`` pattern in ``server/openai.py``),
+           or justify a deliberately detached task with an ignore.
+  TASK002  a call that resolves (through the project call graph) to an
+           ``async def``, used as a bare expression statement: the
+           coroutine object is created and dropped, the body never runs.
+  TASK003  a broad exception swallow (``except Exception`` /
+           ``BaseException`` / ``asyncio.CancelledError`` with a
+           body of only ``pass``) in coroutine-context code: task
+           failures (and cancellation!) disappear without a trace.
+           Narrow except clauses (``ConnectionResetError``) stay legal —
+           they are verdicts, not swallows.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from llm_d_tpu.analysis.callgraph import CallGraph, FuncNode
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+_SPAWNERS = {"create_task", "ensure_future"}
+_BROAD = {"Exception", "BaseException", "CancelledError"}
+
+
+def _is_spawn(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr in _SPAWNERS
+    return isinstance(f, ast.Name) and f.id in _SPAWNERS
+
+
+def _name_used_after(fn_node: ast.AST, name: str, after_line: int) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and node.id == name \
+                and node.lineno > after_line \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> Optional[str]:
+    """The broad exception name this handler catches, if its body is a
+    bare swallow (only ``pass`` / ``...``)."""
+    types: List[ast.expr] = []
+    t = handler.type
+    if t is None:
+        types = []          # bare except: broad by definition
+    elif isinstance(t, ast.Tuple):
+        types = list(t.elts)
+    else:
+        types = [t]
+    names = set()
+    for e in types:
+        if isinstance(e, ast.Name):
+            names.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.add(e.attr)
+    # Report the WIDEST broad name: for ``except (Exception,
+    # CancelledError)`` the cancel-reap exemption must not apply — real
+    # task failures ride the Exception clause.
+    broad = (t is None and "bare except") or next(
+        (n for n in ("BaseException", "Exception", "CancelledError")
+         if n in names), None)
+    if not broad:
+        return None
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue        # docstring / ...
+        return None         # body does something: not a silent swallow
+    return broad if isinstance(broad, str) else "bare except"
+
+
+class TaskPass(Pass):
+    name = "task"
+    rules = {
+        "TASK001": ("create_task/ensure_future handle dropped — task is "
+                    "GC-able mid-run, exception never observed"),
+        "TASK002": "coroutine called without await (never runs)",
+        "TASK003": ("broad except swallows task exceptions/cancellation "
+                    "with a bare pass in coroutine context"),
+    }
+
+    def run(self, ctx: Context) -> List[Finding]:
+        graph = CallGraph.build(ctx)
+        findings: List[Finding] = []
+        for q, fn in graph.functions.items():
+            findings.extend(self._task001(fn))
+            findings.extend(self._task002(graph, fn))
+            findings.extend(self._task003(graph, fn))
+        return findings
+
+    # ---------- TASK001 ----------
+
+    def _task001(self, fn: FuncNode) -> List[Finding]:
+        findings: List[Finding] = []
+        for stmt in ast.walk(fn.node):
+            if isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and _is_spawn(stmt.value):
+                findings.append(Finding(
+                    "TASK001", fn.rel, stmt.lineno,
+                    "task handle discarded at creation — the loop keeps "
+                    "only a weak reference; retain it (self._bg_tasks "
+                    "pattern) or add_done_callback"))
+            elif isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and _is_spawn(stmt.value):
+                tgt = stmt.targets[0]
+                if isinstance(tgt, ast.Name) and not _name_used_after(
+                        fn.node, tgt.id, stmt.lineno):
+                    findings.append(Finding(
+                        "TASK001", fn.rel, stmt.lineno,
+                        f"task handle {tgt.id!r} bound but never retained "
+                        f"(no store/await/add_done_callback) — GC can "
+                        f"cancel the task mid-run"))
+        return findings
+
+    # ---------- TASK002 ----------
+
+    def _task002(self, graph: CallGraph, fn: FuncNode) -> List[Finding]:
+        findings: List[Finding] = []
+        for stmt in ast.walk(fn.node):
+            if not (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            callee_q = graph.resolve_call(fn.qname, stmt.value)
+            if callee_q is None:
+                continue
+            callee = graph.functions.get(callee_q)
+            if callee is not None and callee.is_async:
+                findings.append(Finding(
+                    "TASK002", fn.rel, stmt.lineno,
+                    f"coroutine {callee.cls + '.' if callee.cls else ''}"
+                    f"{callee.name} called without await — the coroutine "
+                    f"object is dropped and the body never runs"))
+        return findings
+
+    # ---------- TASK003 ----------
+
+    def _task003(self, graph: CallGraph, fn: FuncNode) -> List[Finding]:
+        # Coroutine context: the def itself, reachability, or a nested
+        # async def (a fire-and-forget closure like openai's post()).
+        findings: List[Finding] = []
+        in_ctx = graph.is_coroutine_context(fn.qname)
+        # Nested defs execute in their own context: an async closure runs
+        # on the loop, a sync one (thread target, executor helper) does
+        # not — classify each line by its INNERMOST nested def, if any.
+        nested_spans: List[Tuple[range, bool]] = []
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn.node:
+                nested_spans.append(
+                    (range(node.lineno, (node.end_lineno or node.lineno) + 1),
+                     isinstance(node, ast.AsyncFunctionDef)))
+
+        def on_loop(lineno: int) -> bool:
+            inner = None
+            for span, is_async in nested_spans:
+                if lineno in span and (inner is None
+                                       or len(span) < len(inner[0])):
+                    inner = (span, is_async)
+            if inner is not None:
+                return inner[1]
+            return in_ctx
+        # Cancel-then-reap idiom: ``t.cancel(); await t`` MUST swallow
+        # the CancelledError it provoked — that swallow is the protocol,
+        # not a lost failure.  The exemption is scoped to the try whose
+        # body awaits a cancelled object; an unrelated ``.cancel()``
+        # elsewhere in the function must not excuse other swallows.
+        cancelled: set = set()
+        for n in ast.walk(fn.node):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "cancel":
+                try:
+                    cancelled.add(ast.unparse(n.func.value))
+                except Exception:
+                    pass
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Try):
+                continue
+            reaped = False
+            for s in node.body:
+                for sub in ast.walk(s):
+                    if isinstance(sub, ast.Await):
+                        try:
+                            if ast.unparse(sub.value) in cancelled:
+                                reaped = True
+                        except Exception:
+                            pass
+            for handler in node.handlers:
+                if not on_loop(handler.lineno):
+                    continue
+                broad = _broad_handler(handler)
+                if broad == "CancelledError" and reaped:
+                    continue
+                if broad:
+                    findings.append(Finding(
+                        "TASK003", fn.rel, handler.lineno,
+                        f"{broad} swallowed with a bare pass in coroutine "
+                        f"context — task failures (and cancellation) "
+                        f"vanish; log the exception, narrow the clause, "
+                        f"or re-raise"))
+        return findings

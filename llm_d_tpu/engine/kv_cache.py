@@ -208,6 +208,9 @@ class KVCacheManager:
     # ---------- allocation ----------
 
     def _take_free_block(self, region: int = 0) -> Optional[int]:
+        # Ownership handoff by design: the caller (allocate) owns the
+        # rollback — _release on partial-allocation failure.
+        # llmd: ignore[PAIR002] handoff wrapper; allocate() rolls back
         return self.take_block(region=region)
 
     def take_block(self, protected: frozenset = frozenset(),

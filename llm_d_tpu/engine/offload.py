@@ -349,24 +349,45 @@ class HostKVTier:
             return None
         e = self.engine
         km = e.kv_manager
-        b = km.take_block(protected, region=region)
-        if b is None:
-            return None          # everything free is protected; recompute
         bs = e.config.block_size
         stacked = getattr(e, "dp", 1) > 1
         items = _cache_items(e)
         L = items[0][1].shape[1] if stacked else items[0][1].shape[0]
-        slab = _unpack_block_slab(blob, _slab_layout(e), L, bs)
-        local = km.local_block_id(b) if stacked else b
-        ids_dev = jax.numpy.asarray(np.asarray([local], np.int32))
-        for name, arr in slab.items():
-            if stacked:
-                from llm_d_tpu.transfer.connector import _scatter_fn_stacked
-                e.kv_cache[name] = _scatter_fn_stacked(1, bs, region)(
-                    e.kv_cache[name], ids_dev, jax.numpy.asarray(arr))
-            else:
-                e.kv_cache[name] = _scatter_fn(1, bs)(
-                    e.kv_cache[name], ids_dev, jax.numpy.asarray(arr))
+        try:
+            # Unpack BEFORE claiming a device block: a corrupt/stale blob
+            # (config changed under a restart, truncated write) is a tier
+            # miss, not an engine error — and must not leak the block the
+            # old order had already taken when the unpack raised.
+            slab = _unpack_block_slab(blob, _slab_layout(e), L, bs)
+        except (ValueError, struct.error) as exc:
+            # struct.error is NOT a ValueError subclass: a blob truncated
+            # mid-header raises it from unpack_from.
+            logger.warning("host-tier blob %s unusable (%s); dropping it "
+                           "and recomputing", block_hash.hex()[:16], exc)
+            self._store.pop(block_hash, None)
+            if self.server is not None:
+                self.server.unregister(_shared_key(block_hash))
+            return None
+        b = km.take_block(protected, region=region)
+        if b is None:
+            return None          # everything free is protected; recompute
+        try:
+            local = km.local_block_id(b) if stacked else b
+            ids_dev = jax.numpy.asarray(np.asarray([local], np.int32))
+            for name, arr in slab.items():
+                if stacked:
+                    from llm_d_tpu.transfer.connector import (
+                        _scatter_fn_stacked)
+                    e.kv_cache[name] = _scatter_fn_stacked(1, bs, region)(
+                        e.kv_cache[name], ids_dev, jax.numpy.asarray(arr))
+                else:
+                    e.kv_cache[name] = _scatter_fn(1, bs)(
+                        e.kv_cache[name], ids_dev, jax.numpy.asarray(arr))
+        except Exception:
+            # The taken block is not yet registered anywhere — hand it
+            # back before propagating or the pool shrinks permanently.
+            km._release(b)
+            raise
         self._store.move_to_end(block_hash)
         km._hash_of[b] = block_hash
         km._cached[block_hash] = b
@@ -408,8 +429,8 @@ class HostKVTier:
                 # Peer alive, block absent: a healthy miss.
                 self._peer_health.pop(peer, None)
                 continue
-            except (transport.TransferError, ValueError, OSError,
-                    FaultInjected) as exc:
+            except (transport.TransferError, ValueError, struct.error,
+                    OSError, FaultInjected) as exc:
                 # Transport-level unreachability (refused / no route /
                 # timed out) means the PEER is down, not this block: trip
                 # straight into backoff so a dead peer costs ONE timeout

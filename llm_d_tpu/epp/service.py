@@ -111,11 +111,14 @@ class FlowControl:
                 reason="queue_full").inc()
             return "queue_full"
         self._queued += 1
-        self.metrics.flow_control_queue.set(self._queued)
-        timeout = self.queue_timeout_s
-        if max_wait_s is not None:
-            timeout = max(0.0, min(timeout, max_wait_s))
         try:
+            # Everything that can raise sits under the finally from the
+            # first statement on, so the queue count can never be left
+            # stuck high by an exception (PAIR001).
+            self.metrics.flow_control_queue.set(self._queued)
+            timeout = self.queue_timeout_s
+            if max_wait_s is not None:
+                timeout = max(0.0, min(timeout, max_wait_s))
             await asyncio.wait_for(self._sem.acquire(), timeout)
             return "ok"
         except asyncio.TimeoutError:

@@ -122,8 +122,9 @@ class PredictionServer:
                     doc = await resp.json()
                 self.models = {t: LatencyModel.from_dict(d)
                                for t, d in doc.items()}
-            except Exception:
-                pass                      # trainer not up yet; keep old model
+            except Exception as exc:      # trainer not up yet; keep old model
+                logger.debug("latency-model sync failed (%s); keeping the "
+                             "previous model", exc)
             await asyncio.sleep(self.sync_interval_s)
 
     async def predict(self, request: web.Request) -> web.Response:

@@ -285,16 +285,20 @@ class DPWorkerPool:
         ``exc`` None means nothing was committed (the caller serves
         locally)."""
         import aiohttp
-        worker["inflight"] += 1
+        fwd_headers = {k: v for k, v in request.headers.items()
+                       if k.lower() not in self._HOP
+                       and k.lower() != "content-type"}  # json= sets it
+        fwd_headers.update(extra_headers)
         seq = worker["seq"]
         worker["seq"] += 1
         worker["dispatching"].add(seq)
         headers_seen = False
         counted_self = False
-        fwd_headers = {k: v for k, v in request.headers.items()
-                       if k.lower() not in self._HOP
-                       and k.lower() != "content-type"}  # json= sets it
-        fwd_headers.update(extra_headers)
+        # Slot accounting LAST, immediately before the try whose finally
+        # settles it: nothing may raise between the count and the
+        # protection or a failed header build leaks the slot (PAIR001 —
+        # the machine-checked form of PR 9's hand-found double-count).
+        worker["inflight"] += 1
         try:
             async with self._session.post(
                     worker["url"] + request.path_qs, json=body,
@@ -713,8 +717,10 @@ class ModelServer:
                 async with aiohttp.ClientSession(
                         timeout=aiohttp.ClientTimeout(total=1.0)) as s:
                     await s.post(f"{url}/samples", json=samples)
-            except Exception:
-                pass
+            except Exception as exc:    # best-effort telemetry, but not
+                # silent: a permanently-down trainer should be visible in
+                # debug logs, not discovered months later (TASK003).
+                logger.debug("latency-training sample post failed: %s", exc)
         # Hold a strong reference: the loop keeps only a weak one, and a
         # GC'd task silently drops the sample.
         tasks = getattr(self, "_bg_tasks", None)
@@ -741,9 +747,9 @@ class ModelServer:
                 {"error": "deadline exceeded", "request_id": req.request_id},
                 status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})
         self._inflight += 1
-        if self.draining:
-            self.engine.metrics.drain_inflight.set(self._inflight)
         try:
+            if self.draining:
+                self.engine.metrics.drain_inflight.set(self._inflight)
             return await self._run_inner(http_req, body, req, chat)
         finally:
             self._inflight -= 1
@@ -946,9 +952,9 @@ class ModelServer:
         # drain waits for it (the drain contract lets in-flight requests
         # complete) instead of declaring the replica idle mid-resume.
         self._inflight += 1
-        if self.draining:
-            self.engine.metrics.drain_inflight.set(self._inflight)
         try:
+            if self.draining:
+                self.engine.metrics.drain_inflight.set(self._inflight)
             await self._stream_tokens_into(
                 resp, req, body, chat, int(time.time()), journal=journal)
             await resp.write_eof()

@@ -164,9 +164,9 @@ class InferenceSimulator:
         were never held, so they "free the same step").  Returns the
         ticket :meth:`stream_tokens` consumes."""
         self._waiting += 1
-        self._update_gauges()
-        arrival = time.monotonic()
         try:
+            self._update_gauges()
+            arrival = time.monotonic()
             left = (None if deadline_epoch is None
                     else deadline_epoch - time.time())
             try:
@@ -336,7 +336,9 @@ class SimServer:
         async def load():
             await asyncio.sleep(self.sim.config.startup_delay_s)
             self.sim.model_loaded = True
-        asyncio.get_running_loop().create_task(load())
+        # Hold a strong reference: the loop keeps only a weak one, and a
+        # GC'd task would leave the replica never-ready (TASK001).
+        self._load_task = asyncio.get_running_loop().create_task(load())
 
     async def health(self, request: web.Request) -> web.Response:
         if self.sim.dead:

@@ -13,6 +13,11 @@ python -m compileall -q llm_d_tpu tests scripts bench.py __graft_entry__.py
 # through a red integration suite.  (scripts/lint-envvars.py and
 # lint-dockerfile.py are absorbed as passes ENV / DOCKER.)
 python scripts/llmd_check.py
+# The analyzer's own gate (seeded-violation/fixed-twin per RACE/TASK/
+# PAIR/FAULT rule + the PR-9 slot-leak mutation check): a rule that can
+# no longer demonstrably fire is indistinguishable from one that never
+# runs, so this suite runs fail-fast right behind the checker itself.
+python -m pytest tests/test_llmd_race.py -q
 for f in scripts/*.sh docs/monitoring/scripts/*.sh; do bash -n "$f"; done
 # Resilience + lifecycle gates first, fail-fast (injected fault schedules
 # against the sim stack + tiny engines; deadline/SLO-class/drain contract;
@@ -43,4 +48,5 @@ python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_mla_quant.py \
     --ignore=tests/test_collective_quant.py \
-    --ignore=tests/test_stream_recovery.py
+    --ignore=tests/test_stream_recovery.py \
+    --ignore=tests/test_llmd_race.py

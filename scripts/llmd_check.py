@@ -3,12 +3,15 @@
 
 Runs the contract-enforcing static-analysis suite
 (``llm_d_tpu/analysis/``) over the repo: wire-header contract, metric
-registry, env-knob registry, jit/host-sync hygiene, async blocking,
-Pallas kernel invariants, Dockerfile checks.  Run fail-fast by
-``scripts/ci-gate.sh`` before any test collection.
+registry, env-knob registry, jit/host-sync hygiene, async blocking
+(call-graph-routed), interprocedural async races (RACE), asyncio task
+lifecycle (TASK), resource-lifecycle effect pairing (PAIR), fault-point
+coverage (FAULT), Pallas kernel invariants, Dockerfile checks.  Run
+fail-fast by ``scripts/ci-gate.sh`` before any test collection.
 
   python scripts/llmd_check.py                 # full run (CI mode)
-  python scripts/llmd_check.py --changed-only  # git-diff-scoped, sub-second
+  python scripts/llmd_check.py --changed-only  # git-diff-scoped findings
+                                               # (full call graph, ~2s)
   python scripts/llmd_check.py --rules HDR,MET # subset of rule families
   python scripts/llmd_check.py --list-rules    # rule table
   python scripts/llmd_check.py --write-baseline  # snapshot current findings

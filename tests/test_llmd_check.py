@@ -557,6 +557,7 @@ def test_cli_smoke_full_run_and_rule_listing():
         capture_output=True, text=True, timeout=120)
     assert listing.returncode == 0
     for rule in ("HDR001", "MET001", "ENV001", "JIT001", "ASYNC001",
+                 "RACE001", "TASK001", "PAIR001", "FAULT001",
                  "PAL001", "DOCKER001"):
         assert rule in listing.stdout
 

@@ -1,0 +1,1206 @@
+"""llmd-race: the interprocedural analysis layer (callgraph + RACE/TASK/
+PAIR/FAULT) — seeded-violation + fixed-twin fixtures per rule, the
+real-tree meta gate, and the PR 9 mutation check.
+
+The mutation test is the acceptance contract for the whole layer: PR 9's
+satellite fix (a dead DP worker's streaming slot counted twice because
+the release ran off the exception path) was found BY HAND; re-seeding an
+equivalent missing-release into the real ``server/openai.py`` must now
+turn ``llmd_check`` red via PAIR — proving the analyzer catches the bug
+class that previously required a hand-audit.
+
+Stdlib + analysis package only (no jax): stays sub-second in the gate.
+"""
+
+import pathlib
+import sys
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from llm_d_tpu.analysis import (  # noqa: E402
+    Baseline,
+    Context,
+    all_passes,
+    run_passes,
+)
+from llm_d_tpu.analysis.callgraph import CallGraph  # noqa: E402
+from llm_d_tpu.analysis.passes.async_blocking import AsyncBlockingPass  # noqa: E402
+from llm_d_tpu.analysis.passes.faultpoints import FaultPointsPass  # noqa: E402
+from llm_d_tpu.analysis.passes.pair import PairPass  # noqa: E402
+from llm_d_tpu.analysis.passes.race import RacePass  # noqa: E402
+from llm_d_tpu.analysis.passes.task import TaskPass  # noqa: E402
+
+
+def mini_repo(tmp_path, files):
+    for sub in ("llm_d_tpu", "scripts", "tests", "docs", "deploy"):
+        (tmp_path / sub).mkdir(parents=True, exist_ok=True)
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    return Context(tmp_path)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# the call graph itself
+# ---------------------------------------------------------------------------
+
+def test_callgraph_resolves_cross_module_and_propagates_context(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/a.py": '''
+            from llm_d_tpu.b import helper
+
+            async def handler():
+                helper()
+        ''',
+        "llm_d_tpu/b.py": '''
+            def helper():
+                inner()
+
+            def inner():
+                return 1
+        ''',
+    })
+    g = CallGraph.build(ctx)
+    assert "llm_d_tpu/b.py::helper" in g.edges["llm_d_tpu/a.py::handler"]
+    assert "llm_d_tpu/b.py::inner" in g.edges["llm_d_tpu/b.py::helper"]
+    # Coroutine context flows handler -> helper -> inner across modules.
+    assert g.is_coroutine_context("llm_d_tpu/b.py::inner")
+    assert "llm_d_tpu/a.py::handler" in g.roots_of("llm_d_tpu/b.py::inner")
+
+
+def test_callgraph_resolves_self_methods_and_annotations(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/svc.py": '''
+            class Journal:
+                def admit(self):
+                    return 1
+
+            async def relay(journal: Journal):
+                journal.admit()
+
+            class Server:
+                async def run(self):
+                    self._step()
+
+                def _step(self):
+                    return 2
+        ''',
+    })
+    g = CallGraph.build(ctx)
+    assert g.is_coroutine_context("llm_d_tpu/svc.py::Journal.admit")
+    assert g.is_coroutine_context("llm_d_tpu/svc.py::Server._step")
+
+
+def test_callgraph_plain_dotted_import_binds_no_leaf_alias(tmp_path):
+    """Regression: ``import llm_d_tpu.helpers`` binds only ``llm_d_tpu``
+    in Python — registering the leaf name used to fabricate edges for
+    any unrelated local that happened to be called ``helpers``, turning
+    into false ASYNC001/RACE002/TASK002 findings on a clean tree."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/helpers.py": '''
+            import time
+
+            def fetch(url):
+                time.sleep(1)            # blocking, but NOT reachable
+        ''',
+        "llm_d_tpu/gateway.py": '''
+            import llm_d_tpu.helpers
+
+            async def go(helpers):
+                helpers.fetch("x")       # a parameter, not the module
+        ''',
+    })
+    g = CallGraph.build(ctx)
+    assert g.edges["llm_d_tpu/gateway.py::go"] == set()
+    assert not g.is_coroutine_context("llm_d_tpu/helpers.py::fetch")
+    async001 = [f for f in AsyncBlockingPass().run(ctx)
+                if f.rule == "ASYNC001"]
+    assert async001 == []
+
+
+def test_callgraph_executor_closure_gets_no_coroutine_context(tmp_path):
+    """Regression: calls made inside a nested def used to be attributed
+    to the enclosing coroutine, so a helper handed to run_in_executor —
+    the exact fix ASYNC001 recommends — still read as loop-reachable
+    and kept a false ASYNC001 alive."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/helpers.py": _BLOCKING_HELPER,
+        "llm_d_tpu/gateway.py": '''
+            import asyncio
+
+            from llm_d_tpu.helpers import slow_fetch
+
+            async def handler(url):
+                def work():
+                    return slow_fetch(url)      # runs on the executor
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(None, work)
+        ''',
+    })
+    g = CallGraph.build(ctx)
+    assert "llm_d_tpu/helpers.py::slow_fetch" \
+        not in g.edges["llm_d_tpu/gateway.py::handler"]
+    assert not g.is_coroutine_context("llm_d_tpu/helpers.py::slow_fetch")
+    assert [f for f in AsyncBlockingPass().run(ctx)
+            if f.rule == "ASYNC001"] == []
+
+
+# ---------------------------------------------------------------------------
+# ASYNC001 routed through the call graph (satellite)
+# ---------------------------------------------------------------------------
+
+_BLOCKING_HELPER = '''
+    import requests
+
+    def slow_fetch(url):
+        return requests.get(url)         # blocking; NO async def here
+'''
+
+
+def test_async001_catches_blocking_call_in_foreign_sync_module(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/gateway.py": '''
+            from llm_d_tpu.helpers import slow_fetch
+
+            async def handler(url):
+                return slow_fetch(url)
+        ''',
+        "llm_d_tpu/helpers.py": _BLOCKING_HELPER,
+    })
+    findings = AsyncBlockingPass().run(ctx)
+    hits = [f for f in findings if f.rule == "ASYNC001"]
+    assert len(hits) == 1
+    assert hits[0].path == "llm_d_tpu/helpers.py"
+    assert "handler" in hits[0].message          # names the async root
+
+
+def test_async001_interproc_fixed_twin_passes(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/gateway.py": '''
+            from llm_d_tpu.helpers import shape
+
+            async def handler(x):
+                return shape(x)
+        ''',
+        "llm_d_tpu/helpers.py": '''
+            def shape(x):
+                return x * 2
+        ''',
+    })
+    assert AsyncBlockingPass().run(ctx) == []
+
+
+def test_changed_only_keeps_cross_module_findings(tmp_path):
+    """--changed-only must still build the FULL call graph: editing only
+    the helper module must surface the cross-module blocking finding
+    (whose reachability evidence lives in the unchanged gateway)."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/gateway.py": '''
+            from llm_d_tpu.helpers import slow_fetch
+
+            async def handler(url):
+                return slow_fetch(url)
+        ''',
+        "llm_d_tpu/helpers.py": _BLOCKING_HELPER,
+    })
+    ctx.changed = {"llm_d_tpu/helpers.py"}
+    findings, _, _ = run_passes(ctx, [AsyncBlockingPass()])
+    assert [f.rule for f in findings] == ["ASYNC001"]
+    assert findings[0].path == "llm_d_tpu/helpers.py"
+
+
+# ---------------------------------------------------------------------------
+# RACE001: interleaving window across await
+# ---------------------------------------------------------------------------
+
+def test_race001_catches_check_then_act_across_await(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                def __init__(self):
+                    self.slots = 4
+
+                async def reserve(self):
+                    if self.slots <= 0:
+                        return None
+                    await self.refill()
+                    self.slots -= 1
+
+                async def refill(self):
+                    self.slots += 1
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE001"]
+    assert findings and "slots" in findings[0].message
+    assert "refill" in findings[0].message       # names a concurrent writer
+
+
+def test_race001_passes_guarded_and_terminating_twins(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            import asyncio
+
+            class Pool:
+                def __init__(self):
+                    self.slots = 4
+                    self._lock = asyncio.Lock()
+
+                async def reserve(self):
+                    # The fix: one guard held across the whole window.
+                    async with self._lock:
+                        if self.slots <= 0:
+                            return None
+                        await self.refill()
+                        self.slots -= 1
+
+                async def fast(self):
+                    # await-then-return opens no window for later code.
+                    if self.slots == 0:
+                        await self.refill()
+                        return
+                    self.slots -= 1
+
+                async def refill(self):
+                    async with self._lock:
+                        self.slots += 1
+        ''',
+    })
+    assert [f for f in RacePass().run(ctx) if f.rule == "RACE001"] == []
+
+
+def test_race001_catches_lazy_init_check_in_branch_test(tmp_path):
+    """Regression: ``if self.x is None: self.x = await f()`` — the check
+    lives in the branch TEST and the act inside the branch body; the
+    canonical lazy-init race used to land green because the recursive
+    block scan started with no memory of the test's reads."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def conn(self):
+                    if self._conn is None:
+                        self._conn = await self.connect()
+                    return self._conn
+
+                async def close(self):
+                    self._conn = None
+
+                async def connect(self):
+                    return object()
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE001"]
+    assert findings and "_conn" in findings[0].message
+
+
+def test_race001_double_check_after_await_passes(tmp_path):
+    """Regression: the rule's own recommended fix — re-check after the
+    await, in branch-test or sequential form — must not be flagged."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def conn(self):
+                    if self._conn is None:
+                        await self.warmup()
+                        if self._conn is None:   # re-check: window closed
+                            self._conn = self.make()
+                    return self._conn
+
+                async def bump(self):
+                    v = self.count
+                    await self.warmup()
+                    v = self.count               # re-read: fresh check
+                    self.count = v + 1
+
+                async def close(self):
+                    self._conn = None
+                    self.count = 0
+
+                async def warmup(self):
+                    return 1
+
+                def make(self):
+                    return object()
+        ''',
+    })
+    assert [f for f in RacePass().run(ctx) if f.rule == "RACE001"] == []
+
+
+def test_race001_loop_exited_by_break_still_suspends(tmp_path):
+    """Regression: a loop body ending in ``break`` was classified as
+    non-falling-through, but break lands exactly on the statement after
+    the loop — the suspension inside the body opens a real window."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def drain(self, cond):
+                    n = self.count
+                    while cond:
+                        await self.tick()
+                        break
+                    self.count = n + 1
+
+                async def tick(self):
+                    self.count = 0
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE001"]
+    assert findings and "count" in findings[0].message
+
+
+def test_race001_leading_await_does_not_mask_later_windows(tmp_path):
+    """Regression: only the FIRST suspension per block used to register,
+    so any handler that awaited something first (nearly all of them) was
+    never checked for later check-then-act windows."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def handle(self):
+                    await self.connect()
+                    x = self._count
+                    await self.work()
+                    self._count = x + 1
+
+                async def work(self):
+                    self._count = 0
+
+                async def connect(self):
+                    return 1
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE001"]
+    assert findings and "_count" in findings[0].message
+
+
+def test_race001_guarded_with_still_suspends_for_outside_accesses(tmp_path):
+    """Regression: the lock-guard exemption used to swallow the guarded
+    block's suspension entirely, hiding windows whose read and write
+    straddle the ``async with`` from OUTSIDE the guard."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            import asyncio
+
+            class Pool:
+                async def bump(self):
+                    v = self.count               # read OUTSIDE the guard
+                    async with self._lock:
+                        await asyncio.sleep(0)
+                    self.count = v + 1           # write OUTSIDE the guard
+
+                async def reset(self):
+                    self.count = 0
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE001"]
+    assert findings and "count" in findings[0].message
+
+
+def test_race001_nested_def_does_not_hide_sibling_await(tmp_path):
+    """Regression: a nested def visited before the await in the same
+    branch used to abort the await search entirely, so the suspension
+    was never registered and the check-then-act window went unflagged."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def reserve(self):
+                    got = self.pending
+                    if got:
+                        if self.extra:
+                            def cb():
+                                return None
+                            await self.flush()
+                    self.pending = 0
+
+                async def flush(self):
+                    self.pending = 1
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE001"]
+    assert findings and "pending" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# RACE002: lock held across a transitively-reached blocking call
+# ---------------------------------------------------------------------------
+
+def test_race002_catches_lock_over_blocking_call_two_hops_away(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/locks.py": '''
+            import threading
+
+            from llm_d_tpu.helpers import slow_fetch
+
+            _registry_lock = threading.Lock()
+
+            async def handler(url):
+                return refresh(url)
+
+            def refresh(url):
+                with _registry_lock:
+                    return slow_fetch(url)
+        ''',
+        "llm_d_tpu/helpers.py": _BLOCKING_HELPER,
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE002"]
+    assert len(findings) == 1
+    assert "requests.get" in findings[0].message
+    assert findings[0].path == "llm_d_tpu/locks.py"
+
+
+def test_race002_fixed_twin_passes(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/locks.py": '''
+            import threading
+
+            from llm_d_tpu.helpers import shape
+
+            _registry_lock = threading.Lock()
+
+            async def handler(x):
+                return refresh(x)
+
+            def refresh(x):
+                with _registry_lock:
+                    return shape(x)
+        ''',
+        "llm_d_tpu/helpers.py": '''
+            def shape(x):
+                return x * 2
+        ''',
+    })
+    assert [f for f in RacePass().run(ctx) if f.rule == "RACE002"] == []
+
+
+# ---------------------------------------------------------------------------
+# RACE003: lock-order deadlock cycle
+# ---------------------------------------------------------------------------
+
+def test_race003_catches_opposite_acquisition_orders(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/order.py": '''
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def one():
+                with lock_a:
+                    with lock_b:
+                        return 1
+
+            def two():
+                with lock_b:
+                    with lock_a:
+                        return 2
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE003"]
+    assert len(findings) == 1
+    assert "lock_a" in findings[0].message and "lock_b" in findings[0].message
+
+
+def test_race003_consistent_order_passes(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/order.py": '''
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+
+            def one():
+                with lock_a:
+                    with lock_b:
+                        return 1
+
+            def two():
+                with lock_a:
+                    with lock_b:
+                        return 2
+        ''',
+    })
+    assert [f for f in RacePass().run(ctx) if f.rule == "RACE003"] == []
+
+
+def test_race003_survives_duplicate_cycle_plus_extra_root(tmp_path):
+    """Regression: a 2-lock cycle re-found from its second node used to
+    leave the DFS state dirty, so a third lock acquiring into the cycle
+    made the detector fabricate a non-edge 'cycle' and KeyError out —
+    killing the whole checker instead of reporting findings."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/order.py": '''
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+            lock_c = threading.Lock()
+
+            def one():
+                with lock_a:
+                    with lock_b:
+                        return 1
+
+            def two():
+                with lock_b:
+                    with lock_a:
+                        return 2
+
+            def three():
+                with lock_c:
+                    with lock_a:
+                        return 3
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE003"]
+    assert len(findings) == 1           # the a<->b cycle, once; no crash
+    assert "lock_c" not in findings[0].message
+
+
+def test_race003_reports_both_overlapping_cycles(tmp_path):
+    """Regression: reporting only the first cycle per walk hid a second
+    distinct cycle sharing nodes with it — the operator would fix one
+    deadlock, re-run, and only then learn of the other."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/order.py": '''
+            import threading
+
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+            lock_c = threading.Lock()
+
+            def f():
+                with lock_a:
+                    with lock_b:
+                        return 1
+
+            def g():
+                with lock_b:
+                    with lock_c:
+                        return 2
+
+            def h():
+                with lock_c:
+                    with lock_a:
+                        return 3
+
+            def i():
+                with lock_b:
+                    with lock_a:
+                        return 4
+        ''',
+    })
+    findings = [f for f in RacePass().run(ctx) if f.rule == "RACE003"]
+    assert len(findings) == 2           # {a,b,c} AND {a,b}
+
+
+def test_nested_defs_execute_in_their_own_context(tmp_path):
+    """Regression trio: a sync closure handed to an executor/thread runs
+    OFF the loop — RACE002 must not claim its lock blocks the loop,
+    TASK003 must not call its swallow 'coroutine context', and PAIR001
+    must treat a decrement in a done-callback (the TASK001-recommended
+    pattern) as an ownership handoff, not a leak."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/helpers.py": _BLOCKING_HELPER,
+        "llm_d_tpu/svc.py": '''
+            import asyncio
+            import threading
+
+            from llm_d_tpu.helpers import slow_fetch
+
+            class Svc:
+                async def handler(self, url):
+                    def work():
+                        with self._lock:          # held on the EXECUTOR
+                            return slow_fetch(url)
+                    loop = asyncio.get_running_loop()
+                    return await loop.run_in_executor(None, work)
+
+                async def watch(self):
+                    def target():
+                        try:
+                            return slow_fetch("x")
+                        except Exception:
+                            pass                  # thread code, off-loop
+                    threading.Thread(target=target).start()
+
+                async def spawn(self, coro):
+                    self._inflight += 1
+                    task = asyncio.create_task(coro)
+
+                    def _done(t):
+                        self._inflight -= 1       # release at completion
+                    task.add_done_callback(_done)
+                    return task
+        ''',
+    })
+    assert [f for f in RacePass().run(ctx) if f.rule == "RACE002"] == []
+    assert [f for f in TaskPass().run(ctx) if f.rule == "TASK003"] == []
+    assert [f for f in PairPass().run(ctx) if f.rule == "PAIR001"] == []
+
+
+def test_callgraph_lambda_body_gets_no_coroutine_context(tmp_path):
+    """Regression: the lambda form of the executor handoff
+    (``run_in_executor(None, lambda: fetch(url))``) used to fabricate a
+    coroutine-context edge just like the nested-def form once did."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/helpers.py": _BLOCKING_HELPER,
+        "llm_d_tpu/gateway.py": '''
+            import asyncio
+
+            from llm_d_tpu.helpers import slow_fetch
+
+            async def handler(url):
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(
+                    None, lambda: slow_fetch(url))
+        ''',
+    })
+    g = CallGraph.build(ctx)
+    assert not g.is_coroutine_context("llm_d_tpu/helpers.py::slow_fetch")
+    assert [f for f in AsyncBlockingPass().run(ctx)
+            if f.rule == "ASYNC001"] == []
+
+
+# ---------------------------------------------------------------------------
+# TASK: task/coroutine lifecycle
+# ---------------------------------------------------------------------------
+
+def test_task001_catches_dropped_and_unretained_handles(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/bg.py": '''
+            import asyncio
+
+            async def work():
+                return 1
+
+            async def spawn():
+                asyncio.create_task(work())          # discarded outright
+                t = asyncio.create_task(work())      # bound, never retained
+                return None
+        ''',
+    })
+    findings = [f for f in TaskPass().run(ctx) if f.rule == "TASK001"]
+    assert len(findings) == 2
+
+
+def test_task001_retained_handle_passes(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/bg.py": '''
+            import asyncio
+
+            async def work():
+                return 1
+
+            class Svc:
+                def __init__(self):
+                    self._bg = set()
+
+                async def spawn(self):
+                    t = asyncio.create_task(work())
+                    self._bg.add(t)
+                    t.add_done_callback(self._bg.discard)
+                    self._task = asyncio.create_task(work())
+        ''',
+    })
+    assert [f for f in TaskPass().run(ctx) if f.rule == "TASK001"] == []
+
+
+def test_task002_catches_never_awaited_coroutine(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/svc.py": '''
+            class Svc:
+                async def refresh(self):
+                    return 1
+
+                async def tick(self):
+                    self.refresh()
+        ''',
+    })
+    findings = [f for f in TaskPass().run(ctx) if f.rule == "TASK002"]
+    assert len(findings) == 1 and "refresh" in findings[0].message
+
+
+def test_task002_awaited_and_asyncio_run_pass(tmp_path):
+    """``asyncio.run(entry())`` must not be confused with a project
+    function named ``run`` (resolution regression guard)."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/svc.py": '''
+            import asyncio
+
+            async def entry():
+                return 1
+
+            async def run(args):
+                return await entry()
+
+            def main():
+                asyncio.run(run(None))
+
+            class Svc:
+                async def refresh(self):
+                    return 1
+
+                async def tick(self):
+                    await self.refresh()
+        ''',
+    })
+    assert [f for f in TaskPass().run(ctx) if f.rule == "TASK002"] == []
+
+
+def test_task003_catches_broad_swallow_but_allows_cancel_reap(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/svc.py": '''
+            import asyncio
+
+            async def bad():
+                try:
+                    await asyncio.sleep(0)
+                except Exception:
+                    pass
+
+            class Svc:
+                async def stop(self):
+                    self._task.cancel()
+                    try:
+                        await self._task
+                    except asyncio.CancelledError:
+                        pass             # the cancel-then-reap idiom
+        ''',
+    })
+    findings = [f for f in TaskPass().run(ctx) if f.rule == "TASK003"]
+    assert len(findings) == 1
+    assert findings[0].line < 10         # only the bad() swallow
+
+
+def test_task003_unrelated_cancel_does_not_excuse_other_swallows(tmp_path):
+    """Regression: the cancel-then-reap exemption is scoped to the try
+    whose body awaits the cancelled object — cancelling a timer in one
+    block must not green-light a CancelledError swallow around
+    unrelated work elsewhere in the same function."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/svc.py": '''
+            import asyncio
+
+            class Svc:
+                async def shutdown(self):
+                    self._timer.cancel()
+                    try:
+                        await self.flush()
+                    except asyncio.CancelledError:
+                        pass             # swallows OUR cancellation
+
+                async def flush(self):
+                    return 1
+        ''',
+    })
+    findings = [f for f in TaskPass().run(ctx) if f.rule == "TASK003"]
+    assert len(findings) == 1
+
+
+def test_task003_tuple_with_exception_not_excused_by_cancel_reap(tmp_path):
+    """Regression: ``except (Exception, CancelledError)`` around a reap
+    used to be exempted as cancel-then-reap — but real task failures
+    ride the Exception clause and still vanish."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/svc.py": '''
+            import asyncio
+
+            class Svc:
+                async def stop(self):
+                    self._task.cancel()
+                    try:
+                        await self._task
+                    except (Exception, asyncio.CancelledError):
+                        pass             # swallows REAL failures too
+        ''',
+    })
+    findings = [f for f in TaskPass().run(ctx) if f.rule == "TASK003"]
+    assert len(findings) == 1 and "Exception" in findings[0].message
+
+
+def test_task003_logged_handler_passes(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/svc.py": '''
+            import asyncio
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            async def ok():
+                try:
+                    await asyncio.sleep(0)
+                except Exception as exc:
+                    logger.debug("sync failed: %s", exc)
+        ''',
+    })
+    assert [f for f in TaskPass().run(ctx) if f.rule == "TASK003"] == []
+
+
+# ---------------------------------------------------------------------------
+# PAIR: effect pairing on all paths
+# ---------------------------------------------------------------------------
+
+def test_pair001_catches_decrement_off_the_exception_path(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def run(self, req):
+                    self._inflight += 1
+                    out = await self.execute(req)
+                    self._inflight -= 1
+                    return out
+
+                async def execute(self, req):
+                    return req
+        ''',
+    })
+    findings = [f for f in PairPass().run(ctx) if f.rule == "PAIR001"]
+    assert len(findings) == 1 and "_inflight" in findings[0].message
+
+
+def test_pair001_finally_twin_passes(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def run(self, req):
+                    self._inflight += 1
+                    try:
+                        return await self.execute(req)
+                    finally:
+                        self._inflight -= 1
+
+                async def execute(self, req):
+                    return req
+        ''',
+    })
+    assert [f for f in PairPass().run(ctx) if f.rule == "PAIR001"] == []
+
+
+def test_pair001_flags_raising_call_between_inc_and_try(tmp_path):
+    """The protecting try must start IMMEDIATELY: a raising-capable call
+    between the increment and the try leaks the count (the _attempt /
+    FlowControl.acquire shape this PR's sweep fixed)."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def run(self, req):
+                    self._inflight += 1
+                    self.metrics.set(self._inflight)    # can raise: leak
+                    try:
+                        return await self.execute(req)
+                    finally:
+                        self._inflight -= 1
+
+                async def execute(self, req):
+                    return req
+        ''',
+    })
+    findings = [f for f in PairPass().run(ctx) if f.rule == "PAIR001"]
+    assert len(findings) == 1
+
+
+def test_pair001_sibling_branch_call_is_not_a_raise_point(tmp_path):
+    """Regression: a call in the OTHER arm of the if that increments is
+    line-between the inc and the dec but can never execute on the same
+    path — it must not turn exception-safe code into a finding."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                def note(self, fast):
+                    if fast:
+                        self._n += 1
+                    else:
+                        self.work()
+                    self._n -= 1
+
+                def work(self):
+                    return 1
+        ''',
+    })
+    assert [f for f in PairPass().run(ctx) if f.rule == "PAIR001"] == []
+
+
+def test_pair001_decrement_above_increment_settles_nothing(tmp_path):
+    """Regression: an unrelated dec in an EARLIER finally used to count
+    as the protecting release for an inc below it, letting the exact
+    PR 9 leak shape pass clean after a refactor reordered the pair."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/pool.py": '''
+            class Pool:
+                async def run(self, req):
+                    try:
+                        await self.prep(req)
+                    finally:
+                        self._inflight -= 1
+                    self._inflight += 1
+                    await self.risky(req)        # raise here leaks
+
+                async def prep(self, req):
+                    return req
+
+                async def risky(self, req):
+                    return req
+        ''',
+    })
+    findings = [f for f in PairPass().run(ctx) if f.rule == "PAIR001"]
+    assert len(findings) == 1 and "_inflight" in findings[0].message
+
+
+def test_pair002_catches_unreleased_block_and_passes_guarded_twin(tmp_path):
+    seeded = mini_repo(tmp_path / "seeded", {
+        "llm_d_tpu/tier.py": '''
+            class Tier:
+                def restore(self, km, blob):
+                    b = km.take_block()
+                    self.scatter(blob)       # raises -> b leaks
+                    return b
+
+                def scatter(self, blob):
+                    return blob
+        ''',
+    })
+    findings = [f for f in PairPass().run(seeded) if f.rule == "PAIR002"]
+    assert len(findings) == 1 and "take_block" in findings[0].message
+
+    fixed = mini_repo(tmp_path / "fixed", {
+        "llm_d_tpu/tier.py": '''
+            class Tier:
+                def restore(self, km, blob):
+                    b = km.take_block()
+                    try:
+                        self.scatter(blob)
+                    except Exception:
+                        km._release(b)
+                        raise
+                    return b
+
+                def scatter(self, blob):
+                    return blob
+        ''',
+    })
+    assert [f for f in PairPass().run(fixed) if f.rule == "PAIR002"] == []
+
+
+def test_pair002_narrow_except_is_not_raise_path_protection(tmp_path):
+    """Regression: an ``except ValueError`` that releases used to count
+    as full raise-path protection — but an OSError/TypeError from the
+    guarded span still leaks the block permanently.  Only a finally or
+    a broad except covers every raise path."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/tier.py": '''
+            class Tier:
+                def restore(self, km, blob):
+                    b = km.take_block()
+                    try:
+                        self.scatter(blob)   # OSError -> b leaks
+                    except ValueError:
+                        km._release(b)
+                        raise
+                    return b
+
+                def scatter(self, blob):
+                    return blob
+        ''',
+    })
+    findings = [f for f in PairPass().run(ctx) if f.rule == "PAIR002"]
+    assert len(findings) == 1 and "take_block" in findings[0].message
+
+
+def test_pair002_except_exception_insufficient_in_coroutine(tmp_path):
+    """Regression: in a coroutine, cancellation raises CancelledError (a
+    BaseException) at the await — it sails past ``except Exception``, so
+    that handler is NOT raise-path protection for a critical release."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/tier.py": '''
+            class Tier:
+                async def restore(self, km, blob):
+                    b = km.take_block()
+                    try:
+                        await self.scatter(blob)
+                    except Exception:
+                        km._release(b)       # cancellation skips this
+                        raise
+                    return b
+
+                async def scatter(self, blob):
+                    return blob
+        ''',
+    })
+    findings = [f for f in PairPass().run(ctx) if f.rule == "PAIR002"]
+    assert len(findings) == 1 and "take_block" in findings[0].message
+
+
+def test_pair003_catches_success_only_breaker_accounting(tmp_path):
+    seeded = mini_repo(tmp_path / "seeded", {
+        "llm_d_tpu/gw.py": '''
+            async def forward(breaker, addr, post):
+                out = await post(addr)
+                breaker.record_success(addr)
+                return out
+        ''',
+    })
+    findings = [f for f in PairPass().run(seeded) if f.rule == "PAIR003"]
+    assert len(findings) == 1
+
+    fixed = mini_repo(tmp_path / "fixed", {
+        "llm_d_tpu/gw.py": '''
+            async def forward(breaker, addr, post):
+                try:
+                    out = await post(addr)
+                except OSError:
+                    breaker.record_failure(addr)
+                    raise
+                breaker.record_success(addr)
+                return out
+        ''',
+    })
+    assert [f for f in PairPass().run(fixed) if f.rule == "PAIR003"] == []
+
+
+# ---------------------------------------------------------------------------
+# FAULT: fault-point coverage cross-check
+# ---------------------------------------------------------------------------
+
+_FAULT_DOC = '''
+    # resilience
+
+    | Point | Hop | Call site | Models |
+    |---|---|---|---|
+    | `a.b` | x -> y | `llm_d_tpu/hop.py` | y down |
+'''
+
+
+def test_fault_catches_undocumented_untested_uncataloged_point(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/utils/faultinject.py": '''
+            FAULT_POINTS = ("a.b",)
+
+            def get_injector():
+                return None
+        ''',
+        "llm_d_tpu/hop.py": '''
+            from llm_d_tpu.utils.faultinject import get_injector
+
+            def go():
+                get_injector().check("a.b", key="k")
+                get_injector().check("c.d", key="k")
+        ''',
+        "docs/resilience.md": _FAULT_DOC,
+        "tests/test_hop.py": 'POINT = "a.b"\n',
+    })
+    findings = FaultPointsPass().run(ctx)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    assert any("c.d" in m for m in by_rule["FAULT001"])
+    assert any("c.d" in m for m in by_rule["FAULT002"])
+    assert any("c.d" in m for m in by_rule["FAULT003"])
+    assert not any("a.b" in m for ms in by_rule.values() for m in ms)
+
+
+def test_fault002_comment_or_docstring_mention_is_not_coverage(tmp_path):
+    """Regression: coverage used to be a raw substring match over test
+    SOURCE, so a TODO comment or docstring naming the point certified a
+    failure path CI had never walked.  Only string literals count."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/utils/faultinject.py": '''
+            FAULT_POINTS = ("a.b",)
+
+            def get_injector():
+                return None
+        ''',
+        "llm_d_tpu/hop.py": '''
+            from llm_d_tpu.utils.faultinject import get_injector
+
+            def go():
+                get_injector().check("a.b", key="k")
+        ''',
+        "docs/resilience.md": _FAULT_DOC,
+        "tests/test_hop.py": '''
+            """Covers a.b someday."""
+            # TODO: exercise a.b
+            def test_placeholder():
+                assert True
+        ''',
+    })
+    findings = [f for f in FaultPointsPass().run(ctx)
+                if f.rule == "FAULT002"]
+    assert len(findings) == 1 and "a.b" in findings[0].message
+
+
+def test_fault_passes_covered_points_and_flags_stale_catalog(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/utils/faultinject.py": '''
+            FAULT_POINTS = ("a.b", "e.f")
+
+            def get_injector():
+                return None
+        ''',
+        "llm_d_tpu/hop.py": '''
+            from llm_d_tpu.utils.faultinject import get_injector
+
+            def go():
+                get_injector().check("a.b", key="k")
+        ''',
+        "docs/resilience.md": _FAULT_DOC,
+        "tests/test_hop.py": 'POINT = "a.b"\n',
+    })
+    findings = FaultPointsPass().run(ctx)
+    assert rules_of(findings) == {"FAULT003"}    # only the stale e.f row
+    assert "e.f" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# the real tree: meta gate + the PR 9 mutation check
+# ---------------------------------------------------------------------------
+
+def test_real_tree_is_clean_under_the_interprocedural_passes():
+    ctx = Context(REPO)
+    baseline = Baseline(REPO / ".llmd-check-baseline.json")
+    findings, _suppressed, _unused = run_passes(
+        ctx, [AsyncBlockingPass(), RacePass(), TaskPass(), PairPass(),
+              FaultPointsPass()],
+        baseline=baseline)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_real_fault_points_all_covered():
+    """Every shipped fault point has a docs row, a test, and a catalog
+    entry — the coverage FAULT enforces, asserted directly."""
+    ctx = Context(REPO)
+    assert FaultPointsPass().run(ctx) == []
+
+
+def test_mutation_reintroducing_pr9_slot_leak_is_caught(tmp_path):
+    """Re-seed PR 9's DP-slot accounting bug into the REAL openai.py:
+    demote ``_attempt``'s settling ``finally`` to an ``else``, so the
+    dead worker's streaming slot is only released on the no-exception
+    path — the exact double-count that previously needed a hand-audit.
+    PAIR001 must flag it."""
+    src = (REPO / "llm_d_tpu/server/openai.py").read_text()
+    needle = 'finally:\n            worker["inflight"] -= 1'
+    assert needle in src, "mutation anchor moved; update this test"
+    mutated = src.replace(
+        needle, 'else:\n            worker["inflight"] -= 1')
+    assert mutated != src
+    import ast as _ast
+    _ast.parse(mutated)                  # the mutation must stay valid code
+
+    ctx = mini_repo(tmp_path, {})
+    p = tmp_path / "llm_d_tpu/server/openai.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(mutated)
+    ctx = Context(tmp_path)
+    findings = [f for f in PairPass().run(ctx) if f.rule == "PAIR001"]
+    assert any("worker['inflight']" in f.message for f in findings), \
+        "PAIR001 failed to catch the re-seeded PR 9 slot leak"
+
+    # And the unmutated original is clean — the finding IS the mutation.
+    p.write_text(src)
+    ctx = Context(tmp_path)
+    assert [f for f in PairPass().run(ctx) if f.rule == "PAIR001"] == []
