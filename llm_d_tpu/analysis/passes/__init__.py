@@ -16,6 +16,7 @@ from llm_d_tpu.analysis.passes.pair import PairPass
 from llm_d_tpu.analysis.passes.pallas_invariants import PallasPass
 from llm_d_tpu.analysis.passes.race import RacePass
 from llm_d_tpu.analysis.passes.task import TaskPass
+from llm_d_tpu.analysis.passes.trace import TracePass
 
 
 def all_passes() -> List[Pass]:
@@ -29,6 +30,7 @@ def all_passes() -> List[Pass]:
         TaskPass(),
         PairPass(),
         FaultPointsPass(),
+        TracePass(),
         PallasPass(),
         DockerfilePass(),
     ]

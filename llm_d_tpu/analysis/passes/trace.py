@@ -1,0 +1,161 @@
+"""TRACE: span-event coverage on the failure and recovery surface.
+
+llmd-trace's value proposition is that a chaos run is *causally
+explainable* from the trace alone: every fault firing, retry, and
+resume attempt leaves a span event next to the request's timeline.
+That only holds if the code paths that CAN fail or recover actually
+emit — a fault point or retry loop added without an emission produces
+traces with silent gaps exactly where the interesting story is.  These
+rules machine-check the coverage (the FAULT-pass doctrine applied to
+the tracing surface):
+
+  TRACE001  a ``faultinject`` ``check()``/``acheck()`` call site whose
+            enclosing function emits no span/event — the fault would
+            fire causally invisible (the injector's component-level
+            backstop event has no request context).
+  TRACE002  a retry/resume path (a coroutine named ``*retry*`` /
+            ``*resume*`` / ``*failover*``, or any function calling
+            ``note_retry()`` / ``mark_break()`` or incrementing a
+            ``.resume_count``) whose enclosing function emits no
+            span/event.  Functions already covered by TRACE001 (they
+            contain a fault point) are not double-reported.
+
+"Emits" = the function body (nested defs excluded — a callback's
+emission proves nothing about the enclosing path) contains a call whose
+name is one of the tracing APIs: ``start_span`` / ``record_span`` /
+``event_span`` / ``add_event`` / ``trace_event``.  The faultinject and
+tracing modules themselves are exempt (implementation, not call sites).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from llm_d_tpu.analysis.callgraph import walk_excluding_nested_defs
+from llm_d_tpu.analysis.core import Context, Finding, Pass
+
+EXEMPT_MODULES = (
+    "llm_d_tpu/utils/faultinject.py",
+    "llm_d_tpu/utils/tracing.py",
+)
+
+# The emission API surface (utils/tracing.py).  Matching by call NAME
+# keeps the rule robust to how the tracer was reached (module function,
+# tracer method, span method) — and a same-named foreign call would be
+# an emission API look-alike worth a deliberate suppression anyway.
+EMIT_NAMES = {"start_span", "record_span", "event_span", "add_event",
+              "trace_event"}
+
+_RETRY_NAME_RE = re.compile(r"retry|resume|failover")
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _is_fault_check(node: ast.Call) -> bool:
+    """``<injector-ish>.check("point")`` / ``.acheck("point")`` with a
+    string-literal point (the FAULT pass's detection, shared shape)."""
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("check", "acheck")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)):
+        return False
+    try:
+        recv = ast.unparse(node.func.value)
+    except Exception:
+        return False
+    return "injector" in recv or recv == "inj"
+
+
+class _FnScan:
+    """One function's own statements (nested defs excluded)."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.emits = False
+        self.fault_sites: List[Tuple[str, int]] = []   # (point, line)
+        self.retry_markers: List[Tuple[str, int]] = []  # (kind, line)
+        for node in walk_excluding_nested_defs(fn):
+            if isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name in EMIT_NAMES:
+                    self.emits = True
+                elif _is_fault_check(node):
+                    self.fault_sites.append(
+                        (node.args[0].value, node.lineno))
+                elif name in ("note_retry", "mark_break"):
+                    self.retry_markers.append((name, node.lineno))
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.op, ast.Add) \
+                    and isinstance(node.target, ast.Attribute) \
+                    and node.target.attr == "resume_count":
+                self.retry_markers.append(("resume_count+=", node.lineno))
+        # walk order is not source order: anchor findings at the FIRST
+        # marker/site in the file so messages and lines are stable.
+        self.fault_sites.sort(key=lambda t: t[1])
+        self.retry_markers.sort(key=lambda t: t[1])
+
+
+def _functions(tree: ast.Module) -> List[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+class TracePass(Pass):
+    name = "trace"
+    rules = {
+        "TRACE001": ("fault point checked in a function that emits no "
+                     "span event — the firing is causally invisible in "
+                     "traces"),
+        "TRACE002": ("retry/resume path emits no span event — the "
+                     "recovery chain leaves no causal record"),
+    }
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel in list(ctx.package_files) + list(ctx.script_files):
+            if rel in EXEMPT_MODULES:
+                continue
+            src = ctx.source(rel)
+            tree = src.tree
+            if tree is None:
+                continue
+            for fn in _functions(tree):
+                scan = _FnScan(fn)
+                if scan.emits:
+                    continue
+                if scan.fault_sites:
+                    point, line = scan.fault_sites[0]
+                    findings.append(Finding(
+                        "TRACE001", rel, line,
+                        f"fault point {point!r} is checked in "
+                        f"{fn.name}() but the function emits no span "
+                        f"event — add a start_span/record_span/"
+                        f"add_event/trace_event call so the firing is "
+                        f"attributable in traces"))
+                    continue          # one finding per function is enough
+                is_retry_coro = (isinstance(fn, ast.AsyncFunctionDef)
+                                 and _RETRY_NAME_RE.search(fn.name))
+                if scan.retry_markers or is_retry_coro:
+                    if scan.retry_markers:
+                        kind, line = scan.retry_markers[0]
+                        what = f"retry/resume marker {kind!r}"
+                    else:
+                        kind, line = fn.name, fn.lineno
+                        what = f"coroutine name {fn.name!r}"
+                    findings.append(Finding(
+                        "TRACE002", rel, line,
+                        f"{fn.name}() is a retry/resume path ({what}) "
+                        f"but emits no span event — record the attempt "
+                        f"with add_event/start_span so failover chains "
+                        f"read causally in traces"))
+        return findings

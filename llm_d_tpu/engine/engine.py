@@ -36,6 +36,7 @@ from llm_d_tpu.parallel.sharding import logical_to_sharding, shard_pytree
 from llm_d_tpu.ops.quant import (
     KV_CACHE_DTYPES, KV_SCALE_GRANULARITIES, MLA_LATENT_DTYPES,
     kv_scale_width)
+from llm_d_tpu.utils import tracing
 from llm_d_tpu.utils.config import env_choice
 from llm_d_tpu.utils.faultinject import get_injector
 from llm_d_tpu.utils.metrics import EngineMetrics
@@ -268,6 +269,12 @@ class EngineCore:
             max_num_batched_tokens=config.max_num_batched_tokens,
             max_model_len=c.max_model_len)
         self.metrics = metrics or EngineMetrics(c.name)
+        # llmd-trace: engine phase spans (queue/prefill/decode + step
+        # boundaries).  Everything recorded here is host-side clock
+        # arithmetic materialized AFTER the jitted dispatch — tracing can
+        # never add a device sync to the hot loop (the JIT llmd-check
+        # pass and the tests/test_tracing.py guard pin this).
+        self.tracer = tracing.get_tracer("engine")
         # EP interconnect accounting (round 10): on a multi-device mesh
         # every computed token's k routed copies cross the dispatch and
         # combine exchanges once per MoE layer — estimate the wire bytes
@@ -674,7 +681,8 @@ class EngineCore:
         ids_ks, self.kv_cache, routed_ks = self._multistep_fn(
             self.params, self.kv_cache, mbatch, step_key)
         return dict(scheduled=list(scheduled), K=K, meta=meta, rows=rows,
-                    ids_dev=ids_ks, routed_dev=routed_ks)
+                    ids_dev=ids_ks, routed_dev=routed_ks,
+                    t0=time.monotonic())
 
     def _ms_retire(self, inflight: Dict[str, Any]) -> List[RequestOutput]:
         """Synchronize one in-flight block and advance request state."""
@@ -688,6 +696,17 @@ class EngineCore:
         ids_ks = np.asarray(jax.device_get(inflight["ids_dev"]))
         ids_ks = ids_ks.reshape(K, -1)
         self._step_count += K
+        # Fused-decode step span (K engine steps in one device program),
+        # stamped from the dispatch/retire clock reads that already
+        # bracket the sync above — no new sync for tracing.
+        traced = next((sr.request for sr in scheduled
+                       if sr.request.trace_ctx is not None), None)
+        if traced is not None:
+            self.tracer.record_span(
+                "engine.step", self._mono_to_epoch(inflight["t0"]),
+                self._mono_to_epoch(time.monotonic()),
+                parent=traced.trace_ctx, step=self._step_count,
+                kind="decode", fused=K, n_seqs=len(scheduled))
         if self.eplb is not None:
             # Fused decode is EXACTLY the traffic EPLB exists to balance;
             # only real sequences' rows count.  (A successor block already
@@ -740,6 +759,10 @@ class EngineCore:
                     model_name=self.metrics.model_name,
                     finished_reason=finish).inc()
                 self.metrics.e2e_request_latency.observe(now - req.arrival_time)
+                self._trace_phase(
+                    req, "engine.decode", "decode",
+                    req.first_token_time or now, now,
+                    n_tokens=len(req.output_token_ids), finish=finish)
         self._update_queue_metrics()
         return outputs
 
@@ -883,6 +906,25 @@ class EngineCore:
         req = self.pinned_transfers.pop(request_id, None)
         if req is not None:
             self.kv_manager.free(req)
+
+    @staticmethod
+    def _mono_to_epoch(mono: float) -> float:
+        """Engine-clock (monotonic) stamp -> epoch, for retroactive trace
+        spans (request timestamps live on the monotonic clock)."""
+        return time.time() - (time.monotonic() - mono)
+
+    def _trace_phase(self, req: Request, name: str, phase: str,
+                     start_mono: float, end_mono: float, **attrs) -> None:
+        """Record one per-request phase span (no-op for untraced
+        requests) and mirror it into the request_phase histogram."""
+        self.metrics.observe_phase(phase, req.criticality,
+                                   end_mono - start_mono)
+        if req.trace_ctx is None:
+            return
+        self.tracer.record_span(
+            name, self._mono_to_epoch(start_mono),
+            self._mono_to_epoch(end_mono), parent=req.trace_ctx,
+            request_id=req.request_id, phase=phase, **attrs)
 
     def kv_bytes_per_token_layer(self) -> int:
         """Bytes one token's KV costs per layer at the configured cache
@@ -1033,9 +1075,13 @@ class EngineCore:
         for sr in sched.scheduled:
             if sr.is_first_schedule and not sr.request.queue_wait_observed:
                 sr.request.queue_wait_observed = True
+                sr.request.first_schedule_time = sched_now
                 self.metrics.observe_queue_wait(
                     sr.request.criticality,
                     max(0.0, sched_now - sr.request.arrival_time))
+                self._trace_phase(
+                    sr.request, "engine.queue", "queue",
+                    min(sr.request.arrival_time, sched_now), sched_now)
         for req in sched.preempted:      # requests finished by the scheduler
             if req.state is RequestState.FINISHED_DEADLINE:
                 self.metrics.inc_deadline_exceeded(req.criticality)
@@ -1055,6 +1101,7 @@ class EngineCore:
             return outputs
 
         batch, scheduled, rows = self._build_batch(sched)
+        step_t0 = time.monotonic()
         self._rng, step_key = jax.random.split(self._rng)
         # top_logprobs=0 means chosen-token logprob only (no alternatives).
         want_top = any((sr.request.sampling.logprobs or 0) > 0
@@ -1078,6 +1125,20 @@ class EngineCore:
         if top is not None:
             top = (np.asarray(fetched[-2]), np.asarray(fetched[-1]))
         self._step_count += 1
+        # Step-boundary span: stamped AFTER the batched fetch (the one
+        # intended sync point above) from plain clock reads — tracing
+        # adds no sync of its own.  Parented on the first traced request
+        # in the batch; phase tells prefill-heavy from decode steps.
+        traced = next((sr.request for sr in scheduled
+                       if sr.request.trace_ctx is not None), None)
+        if traced is not None:
+            max_new = max(sr.num_new_tokens for sr in scheduled)
+            self.tracer.record_span(
+                "engine.step", self._mono_to_epoch(step_t0),
+                self._mono_to_epoch(time.monotonic()),
+                parent=traced.trace_ctx, step=self._step_count,
+                kind="decode" if max_new == 1 else "prefill",
+                n_seqs=len(scheduled), n_tokens=sched.total_tokens)
         if self.eplb is not None:
             # Record routed logical ids (sampled; padding rows excluded so
             # the zero-embedding's favorite expert doesn't skew the stats)
@@ -1110,6 +1171,19 @@ class EngineCore:
                     req.first_token_time = now
                     self.metrics.time_to_first_token.observe(
                         now - req.arrival_time)
+                    # PD consumer admissions only recompute the last
+                    # prompt token locally — that IS the first-decode
+                    # leg of the PD TTFT decomposition; everything else
+                    # is an ordinary prefill (a resume admission's
+                    # prompt+generated recompute included).
+                    self._trace_phase(
+                        req, "engine.prefill",
+                        "first_decode" if req.do_remote_prefill
+                        else "prefill",
+                        req.first_schedule_time or req.arrival_time, now,
+                        cached_tokens=req.num_cached_prompt_tokens or None,
+                        resume_offset=req.resume_offset or None,
+                        restored_tokens=req.resume_restored_tokens or None)
                 if req.do_remote_decode:
                     # PD producer: stop here, pin blocks, publish transfer params.
                     outputs.append(self._finish_remote_prefill(req, int(ids[s])))
@@ -1142,6 +1216,10 @@ class EngineCore:
                     model_name=self.metrics.model_name,
                     finished_reason=finish).inc()
                 self.metrics.e2e_request_latency.observe(now - req.arrival_time)
+                self._trace_phase(
+                    req, "engine.decode", "decode",
+                    req.first_token_time or now, now,
+                    n_tokens=len(req.output_token_ids), finish=finish)
 
         self._update_queue_metrics()
         return outputs

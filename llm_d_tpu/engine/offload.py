@@ -33,6 +33,7 @@ from __future__ import annotations
 import collections
 import logging
 import struct
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -40,6 +41,7 @@ import numpy as np
 
 from llm_d_tpu.transfer.connector import _cache_items, _gather_fn, _scatter_fn
 from llm_d_tpu.transfer import transport
+from llm_d_tpu.utils import tracing
 from llm_d_tpu.utils.config import env_float, env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 
@@ -332,6 +334,7 @@ class HostKVTier:
         blocks: they sit refcount-0 in the evictor and MUST NOT be chosen
         as the restore target (overwriting one mid-lookup would silently
         corrupt the very prefix being assembled)."""
+        t0 = time.time()
         try:
             # Chaos fault point: tier restore failure (e.g. during a
             # mid-stream resume admission).  A fired fault IS a miss —
@@ -341,11 +344,18 @@ class HostKVTier:
         except FaultInjected as exc:
             logger.warning("kv.restore fault: treating tier restore as a "
                            "miss (%s)", exc)
+            tracing.trace_event("engine", "kv.restore",
+                                block=block_hash.hex()[:16],
+                                verdict="fault_miss")
             return None
+        local = block_hash in self._store
         blob = self._store.get(block_hash)
         if blob is None and self.peers:
             blob = self._fetch_from_peers(block_hash)
         if blob is None:
+            tracing.trace_event("engine", "kv.restore",
+                                block=block_hash.hex()[:16],
+                                verdict="miss")
             return None
         e = self.engine
         km = e.kv_manager
@@ -394,6 +404,13 @@ class HostKVTier:
         km._evictor[km.region_of_block(b)][b] = None
         self.loads += 1
         e.metrics.kv_offload_loads.inc()
+        # Tier verdict + byte count: resume admissions and prefix
+        # restores become attributable in the trace (host tier vs a
+        # peer's shared tier), with the blob size the wire shipped.
+        tracing.get_tracer("engine").record_span(
+            "kv.restore", t0, time.time(),
+            block=block_hash.hex()[:16], verdict="hit",
+            tier="host" if local else "peer", bytes=len(blob))
         return b
 
     def _fetch_from_peers(self, block_hash: bytes) -> Optional[bytes]:
@@ -454,10 +471,16 @@ class HostKVTier:
             self._peer_health.pop(peer, None)
             self.remote_hits += 1
             e.metrics.kv_shared_tier_hits.inc()
+            tracing.trace_event("engine", "kv.peer_fetch", peer=peer,
+                                block=block_hash.hex()[:16],
+                                verdict="hit", bytes=len(blob))
             self._insert(block_hash, blob)
             return blob
         self.remote_misses += 1
         e.metrics.kv_shared_tier_misses.inc()
+        tracing.trace_event("engine", "kv.peer_fetch",
+                            block=block_hash.hex()[:16], verdict="miss",
+                            peers=len(self.peers))
         return None
 
     @property

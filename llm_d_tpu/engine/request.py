@@ -64,6 +64,15 @@ class Request:
     queue_wait_observed: bool = False
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None
+    # llmd-trace: the admitting hop's span context (utils.tracing
+    # TraceContext) — engine phase spans (queue / prefill / decode,
+    # recorded retroactively at step boundaries) parent on it so the
+    # engine's timeline joins the request's end-to-end trace.  None =
+    # untraced admission (direct API use, tests).
+    trace_ctx: Optional[Any] = None
+    # Engine-clock (time.monotonic) stamp of the FIRST schedule — the
+    # queue/prefill phase boundary the trace spans are cut at.
+    first_schedule_time: Optional[float] = None
 
     # --- PD disaggregation ---
     # kv_role=producer engines stop after prefill and publish these;

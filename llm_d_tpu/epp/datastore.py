@@ -85,6 +85,13 @@ class EndpointBreaker:
     def _transition(self, addr: str, s: list, state: str) -> None:
         if s[0] != state:
             logger.info("breaker %s: %s -> %s", addr, s[0], state)
+            # llmd-trace: breaker flips are component-level facts a
+            # per-request span cannot own — the event span makes chaos
+            # timelines (kill -> failures -> open -> half-open -> close)
+            # reconstructable next to the request trees.
+            from llm_d_tpu.utils import tracing
+            tracing.trace_event("epp", "breaker.transition",
+                                endpoint=addr, frm=s[0], to=state)
             s[0] = state
             self._export(addr, state)
 

@@ -32,6 +32,7 @@ import json
 import logging
 import threading
 import time
+import uuid as uuid_mod
 from concurrent import futures
 from typing import Iterator, Optional
 
@@ -39,10 +40,12 @@ import grpc
 
 from llm_d_tpu.epp.protos import external_processor_pb2 as pb
 from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
+from llm_d_tpu.utils import tracing
 from llm_d_tpu.utils.config import env_int
 from llm_d_tpu.utils.lifecycle import (
     CRITICALITY_HEADER,
     DEADLINE_ABS_HEADER,
+    REQUEST_ID_HEADER,
     remaining_s,
 )
 from llm_d_tpu.epp.plugins import RequestCtx
@@ -225,21 +228,39 @@ class ExtProcHandler:
                 return _immediate(429, "flow control queue full")
             if verdict == "timeout":
                 return _immediate(503, "flow control queue timeout")
+        # x-request-id + trace contract on the ext_proc plane: mint the
+        # id when the client sent none and seed the trace from it, same
+        # as the HTTP gateway — both planes must observe identically.
+        rid = ctx.request_id or f"req-{uuid_mod.uuid4().hex[:16]}"
+        span = tracing.get_tracer("extproc").start_span(
+            "extproc.schedule",
+            parent=tracing.parse_trace_headers(headers),
+            request_id=rid, phase="schedule",
+            criticality=ctx.criticality)
         try:
             if expired():        # queue wait may have eaten the budget
+                span.add_event("deadline_expired", where="post-queue")
+                span.end(error="deadline exceeded")
                 return _immediate(504, "deadline exceeded")
             result = self.scheduler.schedule(ctx)
         except (TypeError, ValueError) as exc:
+            span.end(error=f"{type(exc).__name__}: {exc}")
             return _immediate(400, f"invalid request: {exc}")
         finally:
             if self.flow is not None:
                 self.flow.release()
         if ctx.shed:
             self.scheduler.metrics.shed_total.inc()
+            span.end(shed=True)
             return _immediate(
                 429, "shed: no endpoint meets the requested SLOs")
         if result.primary is None:
+            span.end(error="no ready endpoints")
             return _immediate(503, "no ready endpoints")
+        span.end(endpoint=result.primary.address)
+        self.scheduler.metrics.observe_phase(
+            "schedule", ctx.criticality,
+            span.dur if span.dur is not None else 0.0)
         out_headers = dict(result.headers)
         out_headers[DESTINATION_HEADER] = result.primary.address
         # Lifecycle contract rides to the upstream on this plane too: the
@@ -248,6 +269,8 @@ class ExtProcHandler:
         out_headers[CRITICALITY_HEADER] = ctx.criticality
         if ctx.deadline_epoch is not None:
             out_headers[DEADLINE_ABS_HEADER] = f"{ctx.deadline_epoch:.6f}"
+        out_headers[REQUEST_ID_HEADER] = rid
+        out_headers.update(tracing.trace_headers(span.ctx()))
         new_body = None
         if ctx.predictions:
             # Ride the predictions to the model server (same contract as

@@ -80,14 +80,14 @@ class RequestCtx:
             text = "".join(m.get("content", "")
                            for m in body.get("messages", []))
         from llm_d_tpu.utils.lifecycle import (
-            parse_criticality, parse_deadline)
+            REQUEST_ID_HEADER, parse_criticality, parse_deadline)
         return cls(body=body, prompt_text=text, token_ids=token_ids,
                    headers={}, in_headers=in_headers,
                    priority=int(body.get("priority") or 0),
                    criticality=parse_criticality(in_headers, body),
                    deadline_epoch=parse_deadline(in_headers, body),
                    request_id=in_headers.get(
-                       "x-request-id", body.get("request_id", "")))
+                       REQUEST_ID_HEADER, body.get("request_id", "")))
 
     def block_keys(self, block_size: int) -> List[bytes]:
         """Chain block hashes for prefix scoring: token ids when present

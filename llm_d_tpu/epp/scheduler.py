@@ -41,6 +41,12 @@ class SchedulingResult:
     picks: Dict[str, EndpointState]
     headers: Dict[str, str]
     scores: Dict[str, Dict[str, float]]     # profile -> addr -> score
+    # Per-SCORER raw scores (profile -> plugin -> addr -> score): the
+    # llmd-trace scheduling span records the chosen endpoint's breakdown
+    # so a routing decision is explainable per request, not just in
+    # aggregate plugin-duration metrics.
+    breakdown: Dict[str, Dict[str, Dict[str, float]]] = \
+        dataclasses.field(default_factory=dict)
 
     @property
     def primary(self) -> Optional[EndpointState]:
@@ -94,12 +100,14 @@ class EppScheduler:
 
         picks: Dict[str, EndpointState] = {}
         all_scores: Dict[str, Dict[str, float]] = {}
+        all_breakdown: Dict[str, Dict[str, Dict[str, float]]] = {}
         for pname in profile_names:
             profile = self.config.profile(pname)
             if profile is None:
                 continue
-            chosen, scores = self._run_profile(ctx, profile)
+            chosen, scores, breakdown = self._run_profile(ctx, profile)
             all_scores[pname] = scores
+            all_breakdown[pname] = breakdown
             if chosen is not None:
                 picks[pname] = chosen
                 for plugin in self.plugins.values():
@@ -108,7 +116,8 @@ class EppScheduler:
 
         headers = dict(ctx.headers)
         result = SchedulingResult(picks=picks, headers=headers,
-                                  scores=all_scores)
+                                  scores=all_scores,
+                                  breakdown=all_breakdown)
         primary = result.primary
         if primary is not None:
             result.headers[DESTINATION_HEADER] = primary.address
@@ -144,6 +153,7 @@ class EppScheduler:
         candidates = [e for e in self.datastore.candidates(role)
                       if e.ready and e.address not in ctx.excluded_endpoints]
         totals: Dict[str, float] = {e.address: 0.0 for e in candidates}
+        breakdown: Dict[str, Dict[str, float]] = {}
         picker: Optional[Plugin] = None
         picker_ref = None
         for ref in profile.plugins:
@@ -158,6 +168,8 @@ class EppScheduler:
                           for e in candidates}
             scores = plugin.score(ctx, candidates)
             if scores is not None:
+                breakdown[plugin.name] = {
+                    a: round(float(s), 6) for a, s in scores.items()}
                 for addr, s in scores.items():
                     if addr in totals:
                         totals[addr] += ref.weight * s
@@ -168,7 +180,7 @@ class EppScheduler:
                 picker = plugin
                 picker_ref = ref
         if not candidates:
-            return None, totals
+            return None, totals, breakdown
         if picker is None:
             from llm_d_tpu.epp.plugins import MaxScorePicker
             picker = MaxScorePicker("max-score-picker", {}, self.datastore)
@@ -177,4 +189,4 @@ class EppScheduler:
             logger.debug("profile=%s scores=%s chosen=%s", profile.name,
                          {a: round(s, 3) for a, s in totals.items()},
                          chosen.address if chosen else None)
-        return chosen, totals
+        return chosen, totals, breakdown

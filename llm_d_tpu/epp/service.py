@@ -25,6 +25,7 @@ import asyncio
 import json
 import logging
 import time
+import uuid as uuid_mod
 from typing import Dict, List, Optional
 
 import aiohttp
@@ -37,6 +38,7 @@ from llm_d_tpu.epp.plugins import RequestCtx
 from llm_d_tpu.epp.scheduler import DESTINATION_HEADER, EppScheduler
 from llm_d_tpu.server import stream_resume
 from llm_d_tpu.server.stream_resume import StreamJournal
+from llm_d_tpu.utils import tracing
 from llm_d_tpu.utils.config import env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 from llm_d_tpu.utils.lifecycle import (
@@ -44,6 +46,7 @@ from llm_d_tpu.utils.lifecycle import (
     CRITICALITY_SHEDDABLE,
     DEADLINE_ABS_HEADER,
     DEADLINE_EXCEEDED_HEADER,
+    REQUEST_ID_HEADER,
     RETRY_ATTEMPT_HEADER,
     RETRY_BUDGET_HEADER,
     parse_criticality,
@@ -151,6 +154,7 @@ class Gateway:
         app = web.Application()
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/debug/traces", self.debug_traces)
         app.router.add_get("/v1/models", self.models)
         app.router.add_post("/v1/completions", self.proxy_inference)
         app.router.add_post("/v1/chat/completions", self.proxy_inference)
@@ -179,6 +183,16 @@ class Gateway:
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=self.scheduler.metrics.render(),
                             content_type="text/plain")
+
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        """llmd-trace span dump: every component tracer in this process
+        as JSONL (``scripts/trace_report.py`` input; ``?drain=1`` clears
+        the rings after the snapshot — the load tool's post-run scrape)."""
+        drain = request.query.get("drain") in ("1", "true")
+        spans = ([s for t in tracing.all_tracers().values()
+                  for s in t.drain()] if drain else tracing.snapshot_all())
+        return web.Response(text=tracing.render_jsonl(spans),
+                            content_type="application/jsonl")
 
     async def models(self, request: web.Request) -> web.Response:
         for e in self.datastore.candidates():
@@ -215,42 +229,67 @@ class Gateway:
         except ValueError as exc:
             return web.json_response(
                 {"error": f"invalid request: {exc}"}, status=400)
-        expired = self._deadline_expired(criticality, deadline_epoch)
-        if expired is not None:
-            return expired
-        if self.flow is None:
-            return await self._schedule_and_forward(
-                body, request, criticality, deadline_epoch)
-        outcome = await self.flow.acquire(
-            sheddable=priority < 0 or criticality == "sheddable",
-            criticality=criticality,
-            max_wait_s=remaining_s(deadline_epoch))
-        if outcome == "saturated":
-            self.flow.metrics.flow_control_rejects.labels(
-                reason="saturated").inc()
-            return web.json_response(
-                {"error": "saturated: sheddable request refused under "
-                          "load"}, status=429)
-        if outcome in ("queue_full", "timeout"):
-            # A deadline-capped queue timeout is a deadline miss, not an
-            # overload verdict — answer the honest 504.
-            expired = self._deadline_expired(criticality, deadline_epoch)
-            if expired is not None:
-                return expired
-            return web.json_response(
-                {"error": f"overloaded: flow control {outcome}"},
-                status=503)
+        # x-request-id contract: the id is minted HERE when the client
+        # sent none, rides every later hop verbatim (headers AND body, so
+        # the model server's response/stream id matches), and seeds the
+        # trace id — log lines and traces at every component join on it.
+        rid = (in_headers.get(REQUEST_ID_HEADER)
+               or str(body.get("request_id") or "")
+               or f"req-{uuid_mod.uuid4().hex[:16]}")
+        body = dict(body)
+        body.setdefault("request_id", rid)
+        span = tracing.get_tracer("gateway").start_span(
+            "gateway.request",
+            parent=tracing.parse_trace_headers(in_headers),
+            request_id=rid, path=request.path, criticality=criticality)
         try:
-            # Queue time may have eaten the whole budget: refuse before
-            # forwarding rather than burn an upstream slot on a request
-            # the client has already written off.
             expired = self._deadline_expired(criticality, deadline_epoch)
             if expired is not None:
+                span.add_event("deadline_expired", where="pre-queue")
                 return expired
-            return await self._schedule_and_forward(
-                body, request, criticality, deadline_epoch)
+            if self.flow is None:
+                return await self._schedule_and_forward(
+                    body, request, criticality, deadline_epoch, span=span)
+            q0 = time.time()
+            outcome = await self.flow.acquire(
+                sheddable=priority < 0 or criticality == "sheddable",
+                criticality=criticality,
+                max_wait_s=remaining_s(deadline_epoch))
+            tracing.get_tracer("gateway").record_span(
+                "gateway.queue", q0, time.time(), parent=span,
+                phase="queue", outcome=outcome)
+            self.scheduler.metrics.observe_phase(
+                "queue", criticality, time.time() - q0)
+            if outcome == "saturated":
+                self.flow.metrics.flow_control_rejects.labels(
+                    reason="saturated").inc()
+                return web.json_response(
+                    {"error": "saturated: sheddable request refused under "
+                              "load"}, status=429)
+            if outcome in ("queue_full", "timeout"):
+                # A deadline-capped queue timeout is a deadline miss, not
+                # an overload verdict — answer the honest 504.
+                expired = self._deadline_expired(criticality, deadline_epoch)
+                if expired is not None:
+                    span.add_event("deadline_expired", where="queued")
+                    return expired
+                return web.json_response(
+                    {"error": f"overloaded: flow control {outcome}"},
+                    status=503)
+            try:
+                # Queue time may have eaten the whole budget: refuse before
+                # forwarding rather than burn an upstream slot on a request
+                # the client has already written off.
+                expired = self._deadline_expired(criticality, deadline_epoch)
+                if expired is not None:
+                    span.add_event("deadline_expired", where="post-queue")
+                    return expired
+                return await self._schedule_and_forward(
+                    body, request, criticality, deadline_epoch, span=span)
+            finally:
+                self.flow.release()
         finally:
-            self.flow.release()
+            span.end()
 
     def _deadline_expired(self, criticality: str,
                           deadline_epoch: Optional[float]
@@ -266,7 +305,8 @@ class Gateway:
     async def _schedule_and_forward(self, body: Dict,
                                     request: web.Request,
                                     criticality: str = "standard",
-                                    deadline_epoch: Optional[float] = None
+                                    deadline_epoch: Optional[float] = None,
+                                    span: Optional[tracing.Span] = None
                                     ) -> web.StreamResponse:
         """Schedule, forward, and on connect-failure/5xx RE-SCHEDULE on the
         surviving replicas (bounded attempts; failed endpoints are excluded
@@ -279,9 +319,10 @@ class Gateway:
         offset (:mod:`llm_d_tpu.server.stream_resume`)."""
         breaker = self.datastore.breaker
         metrics = self.scheduler.metrics
+        tracer = tracing.get_tracer("gateway")
         max_attempts = 1 + max(0, self.retry_attempts)
         excluded: set = set()
-        rid = ""
+        rid = str(body.get("request_id") or "")
         last_error = "no ready endpoints"
         attempts_made = 0          # forwards actually sent (error reporting)
         policy = stream_resume.resume_policy()
@@ -292,12 +333,16 @@ class Gateway:
                                     deadline_epoch=deadline_epoch)
 
         def note_retry(addr: str, reason: str, error: str) -> None:
-            """Shared retry bookkeeping: breaker, exclusion, metric, log."""
+            """Shared retry bookkeeping: breaker, exclusion, metric, log,
+            trace event (the causal record chaos runs replay)."""
             nonlocal last_error
             breaker.record_failure(addr)
             excluded.add(addr)
             last_error = error
             metrics.gateway_retries.labels(reason=reason).inc()
+            if span is not None:
+                span.add_event("retry", endpoint=addr, reason=reason,
+                               attempt=attempts_made, error=error)
             logger.warning(
                 "retrying request %s on alternate endpoint "
                 "(attempt %d/%d): %s", rid or "-", attempts_made,
@@ -321,11 +366,26 @@ class Gateway:
                 # Scoring may block (prediction-sidecar HTTP, lock
                 # contention): keep it off the event loop so streaming
                 # relays never stall.
+                s0 = time.time()
                 result = await asyncio.to_thread(self.scheduler.schedule, ctx)
             except (TypeError, ValueError) as exc:
                 return web.json_response(
                     {"error": f"invalid request: {exc}",
                      "request_id": rid}, status=400)
+            chosen_addr = (result.primary.address
+                           if result.primary is not None else None)
+            tracer.record_span(
+                "gateway.schedule", s0, time.time(), parent=span,
+                phase="schedule", attempt=attempt, endpoint=chosen_addr,
+                shed=ctx.shed or None,
+                # Per-scorer breakdown for the chosen endpoint: the
+                # routing decision is explainable per request.
+                scores={prof: {plugin: sc.get(chosen_addr)
+                               for plugin, sc in plugins.items()}
+                        for prof, plugins in result.breakdown.items()}
+                if chosen_addr else None)
+            self.scheduler.metrics.observe_phase(
+                "schedule", criticality, time.time() - s0)
             if ctx.shed:
                 # No pod can meet the SLOs and the request is sheddable
                 # (priority < 0): refuse instead of queueing it in the
@@ -356,9 +416,18 @@ class Gateway:
             fwd_headers[CRITICALITY_HEADER] = criticality
             if deadline_epoch is not None:
                 fwd_headers[DEADLINE_ABS_HEADER] = f"{deadline_epoch:.6f}"
+            if rid:
+                fwd_headers[REQUEST_ID_HEADER] = rid
             url = f"{primary.url}{request.path}"
             resp = None
             attempts_made += 1
+            # Forward span: downstream hops (sidecar, model server, sim)
+            # parent their spans on it, so the whole request is ONE
+            # connected tree across processes.
+            fspan = tracer.start_span(
+                "gateway.forward", parent=span,
+                endpoint=primary.address, attempt=attempt)
+            fwd_headers.update(tracing.trace_headers(fspan.ctx()))
             try:
                 await get_injector().acheck("gateway.forward",
                                             key=primary.address)
@@ -381,6 +450,7 @@ class Gateway:
                         note_retry(primary.address, "5xx",
                                    f"upstream {primary.address} "
                                    f"HTTP {upstream.status}")
+                        fspan.end(status=upstream.status)
                         continue
                     if upstream.status >= 500:
                         breaker.record_failure(primary.address)
@@ -398,14 +468,17 @@ class Gateway:
                         await stream_resume.relay_stream(
                             resp, upstream.content, journal,
                             fault_key=primary.address,
-                            stall_timeout_s=policy.stall_timeout_s)
+                            stall_timeout_s=policy.stall_timeout_s,
+                            span=fspan)
                     else:
                         async for chunk in upstream.content.iter_any():
                             await resp.write(chunk)
                     await resp.write_eof()
+                    fspan.end(status=upstream.status)
                     return resp
             except (aiohttp.ClientError, FaultInjected,
                     stream_resume.StreamBroken) as exc:
+                fspan.end(error=f"{type(exc).__name__}: {exc}")
                 if resp is not None:
                     # Headers already went out: a second (json) response
                     # would corrupt the half-sent stream.  A journaled
@@ -417,7 +490,7 @@ class Gateway:
                         return await self._resume_stream(
                             request, resp, journal, policy,
                             excluded | {primary.address}, criticality,
-                            deadline_epoch, exc)
+                            deadline_epoch, exc, span=span)
                     return resp
                 if attempt + 1 < max_attempts:
                     note_retry(primary.address, "connect",
@@ -441,13 +514,16 @@ class Gateway:
         for outcome, secs in journal.take_recoveries():
             metrics.stream_resume.labels(outcome=outcome).inc()
             metrics.request_recovery.observe(secs)
+            metrics.observe_phase("resume", journal.criticality, secs)
 
     async def _resume_stream(self, request: web.Request,
                              resp: web.StreamResponse,
                              journal: StreamJournal, policy,
                              excluded: set, criticality: str,
                              deadline_epoch: Optional[float],
-                             first_exc: BaseException) -> web.StreamResponse:
+                             first_exc: BaseException,
+                             span: Optional[tracing.Span] = None
+                             ) -> web.StreamResponse:
         """Mid-stream decode failover: re-schedule the broken stream on
         the surviving replicas (dead endpoints excluded, breaker-aware)
         and splice the continuation into the client's still-open SSE
@@ -460,6 +536,7 @@ class Gateway:
         the loss."""
         breaker = self.datastore.breaker
         metrics = self.scheduler.metrics
+        tracer = tracing.get_tracer("gateway")
         excluded = set(excluded)
         exc: BaseException = first_exc
         while True:
@@ -481,6 +558,11 @@ class Gateway:
                     or (left is not None and left <= 0):
                 metrics.stream_resume.labels(
                     outcome=stream_resume.OUTCOME_FAILED).inc()
+                if span is not None:
+                    span.add_event(
+                        "resume_exhausted", offset=journal.offset,
+                        attempts=journal.resume_count,
+                        error=f"{type(exc).__name__}: {exc}")
                 logger.error(
                     "stream %s broke at token %d and was NOT recovered "
                     "(%s; attempts=%d/%d, budget_left=%s)",
@@ -490,11 +572,20 @@ class Gateway:
                 return resp               # truncated: today's contract
             journal.resume_count += 1
             journal.mark_break()
+            # Resume-attempt span under the ORIGINAL trace id: the
+            # failover chain stays one connected tree (the resumed
+            # replica's spans parent here), which is what makes a chaos
+            # run's kill -> resume -> continuation causally explainable.
+            rspan = tracer.start_span(
+                "gateway.resume", parent=span,
+                attempt=journal.resume_count, offset=journal.offset,
+                broke=f"{type(exc).__name__}: {exc}")
             try:
                 ctx = self._make_ctx(journal.body, request)
             except (TypeError, ValueError):
                 metrics.stream_resume.labels(
                     outcome=stream_resume.OUTCOME_FAILED).inc()
+                rspan.end(outcome=stream_resume.OUTCOME_FAILED)
                 return resp
             ctx.excluded_endpoints = set(excluded)
             ctx.retry_attempt = journal.resume_count
@@ -503,16 +594,23 @@ class Gateway:
             if primary is None:
                 metrics.stream_resume.labels(
                     outcome=stream_resume.OUTCOME_FAILED).inc()
+                rspan.end(outcome=stream_resume.OUTCOME_FAILED,
+                          error="no surviving resume target")
                 logger.error(
                     "stream %s: no surviving resume target (excluded=%s)",
                     journal.stream_id or "-", sorted(excluded))
                 return resp
+            rspan.set(endpoint=primary.address)
             fwd_headers = {k: v for k, v in result.headers.items()
                            if k != DESTINATION_HEADER}
             fwd_headers.update(journal.resume_headers())
             fwd_headers[CRITICALITY_HEADER] = criticality
             if deadline_epoch is not None:
                 fwd_headers[DEADLINE_ABS_HEADER] = f"{deadline_epoch:.6f}"
+            if journal.body.get("request_id"):
+                fwd_headers[REQUEST_ID_HEADER] = \
+                    str(journal.body["request_id"])
+            fwd_headers.update(tracing.trace_headers(rspan.ctx()))
             logger.warning(
                 "stream %s broke at token %d (%s); resuming on %s "
                 "(attempt %d/%d)", journal.stream_id or "-",
@@ -533,11 +631,14 @@ class Gateway:
                         exc = RuntimeError(
                             f"resume target {primary.address} answered "
                             f"HTTP {upstream.status}")
+                        rspan.end(status=upstream.status,
+                                  outcome="refused")
                         continue
                     await stream_resume.relay_stream(
                         resp, upstream.content, journal,
                         fault_key=primary.address,
-                        stall_timeout_s=policy.stall_timeout_s)
+                        stall_timeout_s=policy.stall_timeout_s,
+                        span=rspan)
             except (aiohttp.ClientError, FaultInjected,
                     stream_resume.StreamBroken) as e:
                 # The resume target died too (possibly after partial
@@ -547,9 +648,12 @@ class Gateway:
                 excluded.add(primary.address)
                 self._drain_recoveries(journal)
                 exc = e
+                rspan.end(error=f"{type(e).__name__}: {e}")
                 continue
             breaker.record_success(primary.address)
             self._drain_recoveries(journal)
+            rspan.end(outcome=journal.last_src
+                      or stream_resume.OUTCOME_RECOMPUTED)
             try:
                 await resp.write_eof()
             except (ConnectionResetError, OSError):

@@ -36,12 +36,14 @@ from llm_d_tpu.engine.request import Request, RequestOutput
 from llm_d_tpu.ops.sampling import SamplingParams
 from llm_d_tpu.server import stream_resume
 from llm_d_tpu.server.stream_resume import StreamJournal
+from llm_d_tpu.utils import tracing
 from llm_d_tpu.utils.config import env_float, env_int
 from llm_d_tpu.utils.faultinject import FaultInjected
 from llm_d_tpu.utils.lifecycle import (
     CRITICALITY_SHEDDABLE,
     DEADLINE_EXCEEDED_HEADER,
     DRAINING_HEADER,
+    REQUEST_ID_HEADER,
     RESUME_OFFSET_HEADER,
     SCHED_DEPTH_HEADER,
     parse_criticality,
@@ -192,6 +194,24 @@ class DPWorkerPool:
             if criticality != CRITICALITY_SHEDDABLE:
                 journal = StreamJournal(body, criticality=criticality,
                                         deadline_epoch=deadline_epoch)
+        # DP dispatch tracing: one child span per ATTEMPT (first forward
+        # + every resume target), parented on the incoming hop so the
+        # leader's balancing decision reads in the request tree.
+        in_hdrs = {k.lower(): v for k, v in request.headers.items()}
+        span = tracing.get_tracer("server").start_span(
+            "server.dp_dispatch",
+            parent=tracing.parse_trace_headers(in_hdrs),
+            request_id=in_hdrs.get(REQUEST_ID_HEADER)
+            or str(body.get("request_id") or "") or None,
+            worker=worker["url"])
+        try:
+            return await self._proxy_attempts(
+                request, body, worker, server, policy, journal, span)
+        finally:
+            span.end()
+
+    async def _proxy_attempts(self, request, body, worker, server,
+                              policy, journal, span):
         resp: Optional[web.StreamResponse] = None
         current: Optional[dict] = worker
         dead: set = set()
@@ -201,9 +221,13 @@ class DPWorkerPool:
             if journal is not None and journal.resume_count:
                 send_body = journal.resume_body()
                 extra_headers = journal.resume_headers()
+            extra_headers.update(tracing.trace_headers(span.ctx()))
+            span.add_event("dispatch", worker=current["url"],
+                           attempt=(journal.resume_count
+                                    if journal is not None else 0))
             resp, broke_exc = await self._attempt(
                 request, send_body, extra_headers, current, journal,
-                resp, policy)
+                resp, policy, span=span)
             self._settle_recoveries(journal, server)
             if broke_exc is None:
                 return resp          # relayed to completion (or None:
@@ -233,11 +257,16 @@ class DPWorkerPool:
                 raise broke_exc
             journal.resume_count += 1
             journal.mark_break()
+            span.add_event("resume", attempt=journal.resume_count,
+                           offset=journal.offset, dead=current["url"],
+                           error=f"{type(broke_exc).__name__}: "
+                                 f"{broke_exc}")
             target = self.alternates(dead)
             if target is None and server is not None:
                 # Every worker host is down: the leader's own engine is
                 # the last resume target.
-                ok = await server.resume_local(request, resp, journal)
+                ok = await server.resume_local(request, resp, journal,
+                                               parent=span)
                 self._settle_recoveries(journal, server)
                 if not journal.done:
                     server.engine.metrics.inc_stream_resume(
@@ -275,7 +304,7 @@ class DPWorkerPool:
                        extra_headers: Dict[str, str], worker: dict,
                        journal: Optional[StreamJournal],
                        resp: Optional[web.StreamResponse],
-                       policy) -> tuple:
+                       policy, span=None) -> tuple:
         """One forward to one worker with per-worker load accounting.
 
         Returns (resp, exc): ``exc`` non-None means the stream died
@@ -354,7 +383,8 @@ class DPWorkerPool:
                     await stream_resume.relay_stream(
                         resp, upstream.content, journal,
                         fault_key=worker["url"],
-                        stall_timeout_s=policy.stall_timeout_s)
+                        stall_timeout_s=policy.stall_timeout_s,
+                        span=span)
                 try:
                     await resp.write_eof()
                 except (ConnectionResetError, OSError):
@@ -420,6 +450,7 @@ class ModelServer:
         app.router.add_get("/health", self.health)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/debug/traces", self.debug_traces)
         app.router.add_get("/version", self.version)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
@@ -476,6 +507,16 @@ class ModelServer:
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=self.engine.metrics.render(),
                             content_type="text/plain")
+
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        """llmd-trace span dump (JSONL; ``?drain=1`` clears the rings) —
+        the ``scripts/trace_report.py`` / ``generate_load.py
+        --trace-export`` scrape surface."""
+        drain = request.query.get("drain") in ("1", "true")
+        spans = ([s for t in tracing.all_tracers().values()
+                  for s in t.drain()] if drain else tracing.snapshot_all())
+        return web.Response(text=tracing.render_jsonl(spans),
+                            content_type="application/jsonl")
 
     async def version(self, request: web.Request) -> web.Response:
         from llm_d_tpu import __version__
@@ -570,8 +611,15 @@ class ModelServer:
 
     def _make_request(self, body: Dict[str, Any], prompt_ids: List[int],
                       headers: Optional[Dict[str, str]] = None) -> Request:
-        rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
         headers = headers or {}
+        # Correlation contract: the body's request_id (the HTTP gateway
+        # writes both) wins, then the x-request-id header (the ext_proc
+        # plane mutates headers only), then a fresh mint — so engine log
+        # lines, the response/stream id, and the trace all join on the
+        # id the first hop chose, whichever plane routed the request.
+        rid = (body.get("request_id")
+               or headers.get(REQUEST_ID_HEADER)
+               or f"cmpl-{uuid_mod.uuid4().hex}")
         # Deadline: absolute epoch from the gateway wins; a bare relative
         # budget (direct client) is based here.  Epoch -> engine monotonic
         # clock so queue time spent BEFORE this hop still counts.
@@ -732,17 +780,34 @@ class ModelServer:
 
     async def _run(self, http_req: web.Request, body: Dict[str, Any],
                    prompt_ids: List[int], chat: bool) -> web.StreamResponse:
+        in_headers = {k.lower(): v for k, v in http_req.headers.items()}
         try:
-            req = self._make_request(
-                body, prompt_ids,
-                {k.lower(): v for k, v in http_req.headers.items()})
+            req = self._make_request(body, prompt_ids, in_headers)
         except (TypeError, ValueError) as exc:
             return web.json_response(
                 {"error": f"invalid request: {exc}"}, status=400)
+        # Admission span: root when the request came straight from a
+        # client, child of the gateway/sidecar hop otherwise; the trace
+        # id seeds from x-request-id / request_id so the engine's log
+        # lines (which carry the rid) join the trace with no lookup.
+        span = tracing.get_tracer("server").start_span(
+            "server.request",
+            parent=tracing.parse_trace_headers(in_headers),
+            request_id=in_headers.get(REQUEST_ID_HEADER, req.request_id),
+            criticality=req.criticality,
+            prompt_tokens=req.num_prompt_tokens,
+            resume_offset=req.resume_offset or None)
+        # Engine-side spans (queue / prefill / decode step boundaries)
+        # parent on the admission span via the request object.
+        req.trace_ctx = span.ctx()
+        logger.debug("request %s admitted (trace=%s criticality=%s "
+                     "prompt_tokens=%d)", req.request_id, span.trace_id,
+                     req.criticality, req.num_prompt_tokens)
         if req.deadline_expired():
             # Budget already blown (e.g. spent queueing at the gateway):
             # refuse before burning a single engine step.
             self.engine.metrics.inc_deadline_exceeded(req.criticality)
+            span.end(error="deadline exceeded at admission")
             return web.json_response(
                 {"error": "deadline exceeded", "request_id": req.request_id},
                 status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})
@@ -755,6 +820,8 @@ class ModelServer:
             self._inflight -= 1
             if self.draining:
                 self.engine.metrics.drain_inflight.set(self._inflight)
+            span.end(completion_tokens=len(req.output_token_ids),
+                     finish=req.state.value)
 
     async def _run_inner(self, http_req: web.Request, body: Dict[str, Any],
                          req: Request, chat: bool) -> web.StreamResponse:
@@ -930,22 +997,31 @@ class ModelServer:
 
     async def resume_local(self, http_req: web.Request,
                            resp: web.StreamResponse,
-                           journal: StreamJournal) -> bool:
+                           journal: StreamJournal,
+                           parent=None) -> bool:
         """Resume a journaled stream on the LOCAL engine (the DP leader's
         last resort when every worker host is down).  Writes the
         remaining tokens into the already-committed client response;
-        returns True when the stream reached [DONE]."""
+        returns True when the stream reached [DONE].  ``parent``
+        (llmd-trace): the dispatch span the resume attempt spans under —
+        the local continuation stays in the original request tree."""
         body = journal.resume_body()
         chat = http_req.path.endswith("/chat/completions")
+        in_headers = {k.lower(): v for k, v in http_req.headers.items()}
         try:
             req = self._make_request(
-                body, self._prompt_ids(body, chat),
-                {k.lower(): v for k, v in http_req.headers.items()})
+                body, self._prompt_ids(body, chat), in_headers)
         except (TypeError, ValueError) as exc:
             logger.error("local resume rejected: %s", exc)
             return False
         if req.deadline_expired():
             return False
+        span = tracing.get_tracer("server").start_span(
+            "server.resume_local",
+            parent=parent if parent is not None
+            else tracing.parse_trace_headers(in_headers),
+            request_id=req.request_id, offset=journal.offset)
+        req.trace_ctx = span.ctx()
         logger.warning("resuming stream %s on the local engine at token "
                        "%d", req.request_id, journal.offset)
         # The resumed stream is in-flight CLIENT work: count it so a
@@ -963,14 +1039,17 @@ class ModelServer:
             # free the engine slot instead of decoding to max_tokens for
             # a disconnected consumer.
             self.async_engine.abort(req.request_id)
+            span.end(error="client gone")
             return False
         except asyncio.CancelledError:
             self.async_engine.abort(req.request_id)
+            span.end(error="cancelled")
             raise
         finally:
             self._inflight -= 1
             if self.draining:
                 self.engine.metrics.drain_inflight.set(self._inflight)
+        span.end(done=journal.done)
         return journal.done
 
     def _sched_depth(self) -> int:

@@ -260,7 +260,8 @@ def _carries_tokens(chunk: Any) -> bool:
 
 async def relay_stream(resp, content, journal: StreamJournal,
                        fault_key: str = "",
-                       stall_timeout_s: float = 0.0) -> None:
+                       stall_timeout_s: float = 0.0,
+                       span=None) -> None:
     """Pump upstream SSE into the client response while journaling.
 
     Returns when the ``[DONE]`` sentinel has been relayed.  Raises
@@ -273,8 +274,14 @@ async def relay_stream(resp, content, journal: StreamJournal,
     must abort, never resume.  Only COMPLETE frames reach the client: a
     trailing partial frame at the break point is discarded, so the
     resumed stream splices at a frame boundary.
+
+    ``span`` (llmd-trace): the relay stamps a ``first_token`` event on
+    it when the first NEW token frame passes (the trace-side TTFT mark
+    the report's decomposition closes against) and a ``stream_stall``
+    event when the watchdog fires.
     """
     buf = b""
+    saw_token = False
     while True:
         await get_injector().acheck("stream.relay", key=fault_key)
         if stall_timeout_s > 0:
@@ -282,6 +289,9 @@ async def relay_stream(resp, content, journal: StreamJournal,
                 chunk = await asyncio.wait_for(
                     content.readany(), stall_timeout_s)
             except asyncio.TimeoutError:
+                if span is not None:
+                    span.add_event("stream_stall",
+                                   timeout_s=stall_timeout_s)
                 raise StreamStall(
                     f"no upstream bytes for {stall_timeout_s:.1f}s "
                     f"(token-gap watchdog)") from None
@@ -295,7 +305,12 @@ async def relay_stream(resp, content, journal: StreamJournal,
         while b"\n\n" in buf:
             frame, buf = buf.split(b"\n\n", 1)
             frame += b"\n\n"
+            before = journal.offset
             if journal.admit_frame(frame):
+                if span is not None and not saw_token \
+                        and journal.offset > before:
+                    saw_token = True
+                    span.add_event("first_token", offset=before)
                 try:
                     await resp.write(frame)
                 except (ConnectionResetError, OSError) as e:

@@ -30,6 +30,7 @@ from typing import List, Optional
 import aiohttp
 from aiohttp import web
 
+from llm_d_tpu.utils import tracing
 from llm_d_tpu.utils.config import env_float, env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 from llm_d_tpu.utils.lifecycle import (
@@ -38,6 +39,7 @@ from llm_d_tpu.utils.lifecycle import (
     DEADLINE_EXCEEDED_HEADER,
     PREFILL_FALLBACK_HEADER,
     PREFILLER_HEADER,
+    REQUEST_ID_HEADER,
     RESUME_ATTEMPT_HEADER,
     RESUME_OFFSET_HEADER,
     parse_criticality,
@@ -117,7 +119,7 @@ class RoutingSidecar:
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
 
-        rid = request.headers.get("x-request-id",
+        rid = request.headers.get(REQUEST_ID_HEADER,
                                   str(body.get("request_id") or ""))
         in_headers = {k.lower(): v for k, v in request.headers.items()}
         try:
@@ -134,12 +136,18 @@ class RoutingSidecar:
             return web.json_response(
                 {"error": "deadline exceeded", "request_id": rid},
                 status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})
-        # Lifecycle headers ride BOTH hops (prefill and local decode).
+        span = tracing.get_tracer("sidecar").start_span(
+            "sidecar.request",
+            parent=tracing.parse_trace_headers(in_headers),
+            request_id=rid or None, criticality=criticality)
+        # Lifecycle + trace headers ride BOTH hops (prefill and local
+        # decode): downstream spans parent on the sidecar span.
         fwd_headers = {CRITICALITY_HEADER: criticality}
         if deadline_epoch is not None:
             fwd_headers[DEADLINE_ABS_HEADER] = f"{deadline_epoch:.6f}"
         if rid:
-            fwd_headers["x-request-id"] = rid
+            fwd_headers[REQUEST_ID_HEADER] = rid
+        fwd_headers.update(tracing.trace_headers(span.ctx()))
         for h in (RESUME_OFFSET_HEADER, RESUME_ATTEMPT_HEADER):
             if h in in_headers:
                 fwd_headers[h] = in_headers[h]
@@ -147,47 +155,58 @@ class RoutingSidecar:
             self.static_prefiller or ""
         prefillers = [p.strip() for p in hint.split(",") if p.strip()]
         local_fallback = False
-        # A mid-stream RESUME never goes through remote prefill: the
-        # decode pod admits prompt+generated locally, restore-first from
-        # its prefix cache / host tier (a remote prefill could only
-        # cover the prompt region and would waste a prefill pod).
-        if prefillers and not body.get("kv_transfer_params") \
-                and not body.get("resume"):
-            decode_body = await self._prefill_with_failover(
-                request.path, body, prefillers, rid,
-                deadline_epoch=deadline_epoch, fwd_headers=fwd_headers)
-            if decode_body is None:
-                # Every prefiller is down: recompute locally on the decode
-                # pod (full local prefill — the request survives the
-                # prefill pool outage at the cost of the decode pod's
-                # compute) instead of the old immediate 502.
-                logger.error(
-                    "all %d prefiller(s) failed (request_id=%s); falling "
-                    "back to local prefill on the decode pod",
-                    len(prefillers), rid or "-")
-                local_fallback = True
-            else:
-                body = decode_body
+        try:
+            # A mid-stream RESUME never goes through remote prefill: the
+            # decode pod admits prompt+generated locally, restore-first
+            # from its prefix cache / host tier (a remote prefill could
+            # only cover the prompt region and would waste a prefill pod).
+            if prefillers and not body.get("kv_transfer_params") \
+                    and not body.get("resume"):
+                decode_body = await self._prefill_with_failover(
+                    request.path, body, prefillers, rid,
+                    deadline_epoch=deadline_epoch,
+                    fwd_headers=fwd_headers, span=span)
+                if decode_body is None:
+                    # Every prefiller is down: recompute locally on the
+                    # decode pod (full local prefill — the request
+                    # survives the prefill pool outage at the cost of the
+                    # decode pod's compute) instead of the old
+                    # immediate 502.
+                    logger.error(
+                        "all %d prefiller(s) failed (request_id=%s); "
+                        "falling back to local prefill on the decode pod",
+                        len(prefillers), rid or "-")
+                    span.add_event("prefill.local_fallback",
+                                   prefillers=len(prefillers))
+                    local_fallback = True
+                else:
+                    body = decode_body
 
-        async with self._session.post(
-                f"{self.decode_url}{request.path}", json=body,
-                headers=fwd_headers) as upstream:
-            resp = await self._relay(request, upstream, request_id=rid,
-                                     extra_headers=(
-                                         {FALLBACK_HEADER: "local"}
-                                         if local_fallback else None))
-            return resp
+            async with self._session.post(
+                    f"{self.decode_url}{request.path}", json=body,
+                    headers=fwd_headers) as upstream:
+                resp = await self._relay(request, upstream, request_id=rid,
+                                         extra_headers=(
+                                             {FALLBACK_HEADER: "local"}
+                                             if local_fallback else None))
+                span.set(status=upstream.status,
+                         local_fallback=local_fallback or None)
+                return resp
+        finally:
+            span.end()
 
     async def _prefill_with_failover(self, path: str, body: dict,
                                      prefillers: List[str],
                                      request_id: str,
                                      deadline_epoch: Optional[float] = None,
-                                     fwd_headers: Optional[dict] = None
-                                     ) -> Optional[dict]:
+                                     fwd_headers: Optional[dict] = None,
+                                     span=None) -> Optional[dict]:
         """Try each prefiller in ranked order, up to ``prefill_retries + 1``
         rounds with capped exponential backoff between rounds.  Returns the
         decode body (kv_transfer_params attached) or None when every
-        attempt failed."""
+        attempt failed.  Each attempt is a child span of ``span`` and
+        each failure a ``prefill.retry`` event, so P->D failover chains
+        read causally in the trace."""
         for rnd in range(max(0, self.prefill_retries) + 1):
             if rnd:
                 # Cap the exponential so a long retry budget cannot park a
@@ -199,12 +218,15 @@ class RoutingSidecar:
             if left is not None and left <= 0:
                 # Budget gone mid-failover: stop — the decode hop renders
                 # the authoritative 504.
+                if span is not None:
+                    span.add_event("prefill.deadline_exhausted", round=rnd)
                 return None
             for prefiller in prefillers:
                 try:
                     out = await self._run_prefill(
                         path, body, prefiller,
-                        deadline_epoch=deadline_epoch, headers=fwd_headers)
+                        deadline_epoch=deadline_epoch, headers=fwd_headers,
+                        span=span, rnd=rnd)
                     if rnd or prefiller != prefillers[0]:
                         logger.warning(
                             "prefill failover succeeded via %s "
@@ -215,6 +237,11 @@ class RoutingSidecar:
                     logger.warning(
                         "prefill via %s failed (round %d, request_id=%s): "
                         "%s", prefiller, rnd, request_id or "-", e)
+                    if span is not None:
+                        span.add_event("prefill.retry",
+                                       prefiller=prefiller, round=rnd,
+                                       error=str(e),
+                                       permanent=e.permanent or None)
                     if e.permanent:
                         # Request-level failure: skip the remaining
                         # failover budget, let the decode pod answer.
@@ -223,7 +250,8 @@ class RoutingSidecar:
 
     async def _run_prefill(self, path: str, body: dict, prefiller: str,
                            deadline_epoch: Optional[float] = None,
-                           headers: Optional[dict] = None) -> dict:
+                           headers: Optional[dict] = None,
+                           span=None, rnd: int = 0) -> dict:
         """Step 1 of the PD contract: remote prefill, returns the decode body.
 
         The prefill request mirrors the original but generates a single
@@ -244,6 +272,15 @@ class RoutingSidecar:
         left = remaining_s(deadline_epoch)
         if left is not None:
             timeout_s = max(0.001, min(timeout_s, left))
+        # One span per prefill ATTEMPT (phase "prefill": the remote-
+        # prefill leg of the PD TTFT decomposition as the sidecar sees
+        # it — engine-side compute + both wire directions).
+        pspan = tracing.get_tracer("sidecar").start_span(
+            "sidecar.prefill", parent=span, phase="prefill",
+            prefiller=prefiller, round=rnd)
+        if headers is not None:
+            headers = dict(headers)
+            headers.update(tracing.trace_headers(pspan.ctx()))
         try:
             await get_injector().acheck("sidecar.prefill", key=prefiller)
             # sock_connect bound: a blackholed prefiller (dead node, SYNs
@@ -262,14 +299,20 @@ class RoutingSidecar:
                     raise PrefillError(f"HTTP {resp.status}",
                                        permanent=400 <= resp.status < 500)
                 payload = await resp.json()
+        except PrefillError as e:
+            pspan.end(error=str(e))
+            raise
         except (aiohttp.ClientError, asyncio.TimeoutError,
                 json.JSONDecodeError, FaultInjected) as e:
             # JSONDecodeError: a 200 with a garbled/truncated body is a
             # misbehaving prefiller like any other — fail over, don't 500.
+            pspan.end(error=str(e) or type(e).__name__)
             raise PrefillError(str(e) or type(e).__name__) from e
         params = payload.get("kv_transfer_params")
         if not params:
+            pspan.end(error="missing kv_transfer_params")
             raise PrefillError("prefill response missing kv_transfer_params")
+        pspan.end(blocks=len(params.get("remote_block_ids") or ()))
         decode_body = dict(body)
         decode_body["kv_transfer_params"] = params
         return decode_body

@@ -30,11 +30,13 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from llm_d_tpu.server import stream_resume
+from llm_d_tpu.utils import tracing
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 from llm_d_tpu.utils.hashing import hash_token_blocks
 from llm_d_tpu.utils.lifecycle import (
     DEADLINE_EXCEEDED_HEADER,
     DRAINING_HEADER,
+    REQUEST_ID_HEADER,
     RESUME_OFFSET_HEADER,
     parse_criticality,
     parse_deadline,
@@ -82,6 +84,11 @@ class InferenceSimulator:
                  kv_event_sink=None) -> None:
         self.config = config
         self.metrics = EngineMetrics(config.model)
+        # llmd-trace: the sim emits the SAME span shapes as the real
+        # engine (queue/prefill/decode phases, first_token event), so
+        # the trace_report TTFT decomposition validates on CPU-only
+        # machines against the full gateway -> replica tree.
+        self.tracer = tracing.get_tracer("sim")
         self.started_at = time.time()
         self.model_loaded = False
         # Lifecycle mirror: draining refuses new work (503) while
@@ -157,12 +164,14 @@ class InferenceSimulator:
     async def admit(self, prompt_ids: List[int], max_tokens: int,
                     deadline_epoch: Optional[float] = None,
                     criticality: str = "standard",
-                    start: int = 0) -> Dict[str, Any]:
+                    start: int = 0, span=None) -> Dict[str, Any]:
         """Queue for a running slot.  Raises :class:`DeadlineExceeded`
         when the budget expires while queued (mirrors the real
         scheduler's queued-deadline rejection; the simulated KV blocks
         were never held, so they "free the same step").  Returns the
-        ticket :meth:`stream_tokens` consumes."""
+        ticket :meth:`stream_tokens` consumes.  ``span`` (llmd-trace):
+        the request span the queue/prefill/decode phase spans parent on."""
+        q0 = time.time()
         self._waiting += 1
         try:
             self._update_gauges()
@@ -182,8 +191,12 @@ class InferenceSimulator:
         finally:
             self._waiting -= 1
             self._update_gauges()
-        self.metrics.observe_queue_wait(
-            criticality, time.monotonic() - arrival)
+        wait_s = time.monotonic() - arrival
+        self.metrics.observe_queue_wait(criticality, wait_s)
+        self.metrics.observe_phase("queue", criticality, wait_s)
+        if span is not None:
+            self.tracer.record_span("sim.queue", q0, time.time(),
+                                    parent=span, phase="queue")
         n_blocks = (len(prompt_ids) + max_tokens) // \
             self.config.block_size + 1
         self._running += 1
@@ -193,7 +206,8 @@ class InferenceSimulator:
                 "deadline_epoch": deadline_epoch,
                 "criticality": criticality, "n_blocks": n_blocks,
                 "arrival": arrival, "expired": False, "released": False,
-                "start": start, "resume_src": None, "resume_restored": 0}
+                "start": start, "resume_src": None, "resume_restored": 0,
+                "span": span}
 
     def release_ticket(self, ticket: Dict[str, Any]) -> None:
         """Idempotent slot/block release.  ``stream_tokens`` calls this in
@@ -233,7 +247,10 @@ class InferenceSimulator:
         arrival = ticket["arrival"]
         deadline_epoch = ticket["deadline_epoch"]
         start = ticket.get("start", 0)
+        span = ticket.get("span")
+        criticality = ticket["criticality"]
         try:
+            p0 = time.time()
             cached = self._prefix_hit_tokens(prompt_ids)
             self.metrics.prefix_cache_queries.inc(len(prompt_ids))
             if cached:
@@ -249,6 +266,10 @@ class InferenceSimulator:
                     await get_injector().acheck("kv.restore", key=c.model)
                 except FaultInjected:
                     restored = False
+                if span is not None:
+                    span.add_event("kv.restore",
+                                   verdict="hit" if restored else "miss",
+                                   offset=start)
                 ticket["resume_src"] = (
                     stream_resume.OUTCOME_RESTORED if restored
                     else stream_resume.OUTCOME_RECOMPUTED)
@@ -260,9 +281,21 @@ class InferenceSimulator:
             self.metrics.prompt_tokens.inc(len(prompt_ids))
             self.metrics.time_to_first_token.observe(
                 time.monotonic() - arrival)
+            # Prefill phase span closes at the first-token boundary (the
+            # report's decomposition splices it after the gateway's
+            # queue+schedule legs).
+            now = time.time()
+            self.metrics.observe_phase("prefill", criticality, now - p0)
+            if span is not None:
+                self.tracer.record_span(
+                    "sim.prefill", p0, now, parent=span, phase="prefill",
+                    cached_tokens=cached or None,
+                    resume_offset=start or None)
+                span.add_event("first_token", offset=start)
             self._store_prefix(prompt_ids)
             reason = "length"
             emitted = 0
+            d0 = time.time()
             for i in range(start, ticket["max_tokens"]):
                 if self.dead:
                     raise RuntimeError("engine dead")
@@ -272,6 +305,8 @@ class InferenceSimulator:
                     self.dead = True
                     logger.error("sim %s: engine.step fault — replica is "
                                  "now dead", c.model)
+                    if span is not None:
+                        span.add_event("fault.engine.step", token=i)
                     raise
                 if emitted > 0:
                     await asyncio.sleep(c.tpot_ms / 1e3)
@@ -292,6 +327,12 @@ class InferenceSimulator:
                 finished_reason=reason).inc()
             self.metrics.e2e_request_latency.observe(
                 time.monotonic() - arrival)
+            self.metrics.observe_phase("decode", criticality,
+                                       time.time() - d0)
+            if span is not None:
+                self.tracer.record_span(
+                    "sim.decode", d0, time.time(), parent=span,
+                    phase="decode", n_tokens=emitted, finish=reason)
         finally:
             self.release_ticket(ticket)
 
@@ -316,6 +357,7 @@ class SimServer:
         app.router.add_get("/health", self.health)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/debug/traces", self.debug_traces)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/admin/drain", self.admin_drain)
@@ -364,6 +406,14 @@ class SimServer:
         return web.Response(body=self.sim.metrics.render(),
                             content_type="text/plain")
 
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        """llmd-trace span dump (JSONL; ``?drain=1`` clears the rings)."""
+        drain = request.query.get("drain") in ("1", "true")
+        spans = ([s for t in tracing.all_tracers().values()
+                  for s in t.drain()] if drain else tracing.snapshot_all())
+        return web.Response(text=tracing.render_jsonl(spans),
+                            content_type="application/jsonl")
+
     async def completions(self, request: web.Request) -> web.StreamResponse:
         return await self._run(request, chat=False)
 
@@ -375,7 +425,9 @@ class SimServer:
             body = await http_req.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid json"}, status=400)
-        rid = body.get("request_id") or f"cmpl-{uuid_mod.uuid4().hex}"
+        rid = (body.get("request_id")
+               or http_req.headers.get(REQUEST_ID_HEADER)
+               or f"cmpl-{uuid_mod.uuid4().hex}")
         if self.sim.dead:
             # Dead-engine mirror: fail fast like the real server's
             # /health-500 engine (gateway retries/resumes elsewhere).
@@ -407,8 +459,6 @@ class SimServer:
         max_tokens = int(body.get("max_tokens",
                                   body.get("max_completion_tokens", 16)))
         created = int(time.time())
-        stream = bool(body.get("stream", False))
-        model = self.sim.config.model
         # Mid-stream resume handshake (mirrors the real model server):
         # the relay's journal offset arrives as x-llmd-resume-offset /
         # body["resume"]; token i depends only on (prompt, i), so the
@@ -426,13 +476,35 @@ class SimServer:
                 {"error": f"resume offset {start} out of range",
                  "request_id": rid}, status=400)
 
+        # Request span: child of the forwarding hop (gateway / sidecar /
+        # DP leader) when trace headers arrived, root otherwise — the
+        # trace id seeds from the request id either way, so a resumed
+        # stream's spans land under the ORIGINAL trace.
+        span = self.sim.tracer.start_span(
+            "sim.request",
+            parent=tracing.parse_trace_headers(in_headers),
+            request_id=rid, criticality=criticality,
+            resume_offset=start or None)
+        try:
+            return await self._run_traced(
+                http_req, body, chat, rid, prompt_ids, max_tokens,
+                deadline_epoch, criticality, start, created, span)
+        finally:
+            span.end()
+
+    async def _run_traced(self, http_req, body, chat, rid, prompt_ids,
+                          max_tokens, deadline_epoch, criticality, start,
+                          created, span) -> web.StreamResponse:
+        stream = bool(body.get("stream", False))
+        model = self.sim.config.model
         try:
             # Admission BEFORE the stream is prepared so a queued-deadline
             # expiry can still answer an honest 504.
             ticket = await self.sim.admit(prompt_ids, max_tokens,
                                           deadline_epoch, criticality,
-                                          start=start)
+                                          start=start, span=span)
         except DeadlineExceeded:
+            span.add_event("deadline_expired", where="queued")
             return web.json_response(
                 {"error": "deadline exceeded", "request_id": rid},
                 status=504, headers={DEADLINE_EXCEEDED_HEADER: "1"})

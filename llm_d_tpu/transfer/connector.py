@@ -39,6 +39,7 @@ import numpy as np
 
 from llm_d_tpu.engine.request import Request, RequestOutput, RequestState
 from llm_d_tpu.transfer import transport
+from llm_d_tpu.utils import tracing
 from llm_d_tpu.utils.config import env_float, env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 
@@ -126,6 +127,11 @@ class TpuConnector:
         blob = _pack_blocks(engine, req.block_ids)
         self.server.register(req.request_id, blob)
         self._pin_times[req.request_id] = time.monotonic()
+        # Producer-side stage mark: how many bytes this prefill pinned
+        # for the consumer's pull (the other end of kv.transfer).
+        tracing.trace_event("engine", "kv.stage", parent=req.trace_ctx,
+                            request_id=req.request_id, bytes=len(blob),
+                            blocks=len(req.block_ids))
 
     def _poll_producer(self, engine) -> None:
         if self.server is None:
@@ -165,6 +171,7 @@ class TpuConnector:
 
     def _fetch_worker(self, req: Request, params: Dict[str, Any]) -> None:
         t0 = time.perf_counter()
+        wall0 = time.time()
         blob: Optional[bytes] = None
         error: Optional[str] = None
         retries = max(0, self.config.pull_retries)
@@ -198,6 +205,10 @@ class TpuConnector:
                     logger.warning(
                         "kv pull for %s failed (%s); retry %d/%d",
                         req.request_id, error, attempt + 1, retries)
+                    tracing.trace_event(
+                        "engine", "kv.pull_retry", parent=req.trace_ctx,
+                        request_id=req.request_id, attempt=attempt + 1,
+                        error=error)
                     time.sleep(self.config.pull_backoff_s * (2 ** attempt))
                 continue
             try:
@@ -211,6 +222,14 @@ class TpuConnector:
                 logger.warning("kv release for %s failed (%s); producer "
                                "pin timeout will reclaim", req.request_id, e)
             break
+        # P->D wire span (phase "transfer"): the KV-transfer leg of the
+        # PD TTFT decomposition, with the byte count the NetKV-style
+        # transfer-cost scorer will want per link.
+        tracing.get_tracer("engine").record_span(
+            "kv.transfer", wall0, time.time(), parent=req.trace_ctx,
+            request_id=req.request_id, phase="transfer",
+            bytes=len(blob) if blob else 0,
+            source=f"{host}:{port}", error=error)
         self._loaded.put((req, blob, error, time.perf_counter() - t0))
 
     def abort(self, request_id: str) -> None:
@@ -282,6 +301,7 @@ class TpuConnector:
                 outputs.extend(self._load_failed(engine, req, error or "empty"))
                 continue
             engine.metrics.kv_transfer_time.observe(dt)
+            engine.metrics.observe_phase("transfer", req.criticality, dt)
             ready.append((req, blob))
         if self._aborted:
             dropped = [r for r, _ in ready if r.request_id in self._aborted]

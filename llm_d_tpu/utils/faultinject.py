@@ -236,7 +236,18 @@ class FaultInjector:
                 if f and not fire:
                     fire, label = True, rule.label
                     self.fired_log.append((point, key, rule.calls))
-            return fire, latency, label
+        if fire:
+            # llmd-trace: every fired fault leaves a span event so a
+            # chaos run's fault -> retry -> resume chain is causally
+            # explainable from the trace alone (call sites add their own
+            # request-parented events; this is the component-level
+            # backstop that fires even where the exception propagates
+            # out of span scope).  Emitted OUTSIDE the rule lock; lazy
+            # import keeps the no-rules fast path import-free.
+            from llm_d_tpu.utils import tracing
+            tracing.trace_event("fault", f"fault.{point}",
+                                key=key, label=label)
+        return fire, latency, label
 
     def check(self, point: str, key: str = "") -> None:
         """Sync fault point (engine thread / worker threads).  May sleep

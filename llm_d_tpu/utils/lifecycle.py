@@ -44,6 +44,22 @@ drift apart:
                          no duplicate or missing token indices.
   x-llmd-resume-attempt  request header: resume attempt index (1..max),
                          for upstream log correlation and loop bounds.
+  x-request-id           the request's correlation id: minted at the
+                         FIRST hop that sees the request without one
+                         (normally the gateway) and propagated verbatim
+                         after that — log lines at every component and
+                         the trace id (below) all join on this one key.
+  traceparent            W3C trace-context header (``00-<trace>-<span>-
+                         <flags>``), accepted AND emitted so external
+                         tooling interoperates with llmd-trace.
+  x-llmd-trace-id        32-hex trace id (sha256-seeded from
+                         x-request-id at the root hop, so logs and
+                         traces join without a lookup table).
+  x-llmd-trace-parent    16-hex span id of the sending hop's span — the
+                         receiving hop parents its spans on it.
+  x-llmd-trace-sampled   "1"/"0": the root hop's sampling verdict
+                         (``LLMD_TRACE_SAMPLE``); later hops honor it so
+                         a trace is recorded everywhere or nowhere.
 
 Criticality maps to priority *tiers* consumed by the engine scheduler's
 ``(priority, arrival)`` queue order and by preemption victim selection:
@@ -72,6 +88,11 @@ PREFILLER_HEADER = "x-prefiller-host-port"
 PREFILL_FALLBACK_HEADER = "x-llmd-prefill-fallback"
 RESUME_OFFSET_HEADER = "x-llmd-resume-offset"
 RESUME_ATTEMPT_HEADER = "x-llmd-resume-attempt"
+REQUEST_ID_HEADER = "x-request-id"
+TRACEPARENT_HEADER = "traceparent"
+TRACE_ID_HEADER = "x-llmd-trace-id"
+TRACE_PARENT_HEADER = "x-llmd-trace-parent"
+TRACE_SAMPLED_HEADER = "x-llmd-trace-sampled"
 
 CRITICALITY_CRITICAL = "critical"
 CRITICALITY_STANDARD = "standard"

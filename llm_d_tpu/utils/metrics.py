@@ -40,6 +40,15 @@ COLLECTIVE_BYTES_METRIC = "llmd_tpu:collective_bytes_total"
 # relays that journal streams; registries are per-component.
 STREAM_RESUME_METRIC = "llmd_tpu:stream_resume_total"
 REQUEST_RECOVERY_METRIC = "llmd_tpu:request_recovery_seconds"
+# llmd-trace's span->Prometheus bridge: per-request phase durations
+# (queue | schedule | prefill | transfer | first_decode | decode |
+# resume — utils/tracing.py PHASES) by criticality class.  This is the
+# TTFT decomposition ROADMAP item 2's gated PD bench metric consumes,
+# folded into the existing Grafana world; declared on BOTH the gateway
+# (EppMetrics: queue/schedule phases) and the model server/sim
+# (EngineMetrics: prefill/transfer/decode phases) — registries are
+# per-component.
+REQUEST_PHASE_METRIC = "llmd_tpu:request_phase_seconds"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -163,6 +172,19 @@ class EngineMetrics:
         self.request_recovery = histo(
             REQUEST_RECOVERY_METRIC,
             "Mid-stream break detection to first resumed token.")
+        # llmd-trace phase bridge (see REQUEST_PHASE_METRIC).
+        self._request_phase = Histogram(
+            REQUEST_PHASE_METRIC,
+            "Per-request phase duration (TTFT/TPOT attribution), by "
+            "phase and criticality class.",
+            ["model_name", "phase", "criticality"], buckets=_TIME_BUCKETS,
+            registry=self.registry)
+
+    def observe_phase(self, phase: str, criticality: str,
+                      seconds: float) -> None:
+        self._request_phase.labels(
+            model_name=self.model_name, phase=phase,
+            criticality=criticality).observe(max(0.0, seconds))
 
     def inc_stream_resume(self, outcome: str) -> None:
         self._stream_resume.labels(
@@ -259,6 +281,21 @@ class EppMetrics:
             REQUEST_RECOVERY_METRIC,
             "Mid-stream break detection to first resumed token.",
             buckets=_TIME_BUCKETS, registry=self.registry)
+        # llmd-trace phase bridge, gateway side (queue = flow-control
+        # wait, schedule = plugin-pipeline decision); the engine-side
+        # twin lives on EngineMetrics (see REQUEST_PHASE_METRIC).
+        self._request_phase = Histogram(
+            REQUEST_PHASE_METRIC,
+            "Per-request phase duration at the gateway (TTFT "
+            "attribution), by phase and criticality class.",
+            ["phase", "criticality"], buckets=_TIME_BUCKETS,
+            registry=self.registry)
+
+    def observe_phase(self, phase: str, criticality: str,
+                      seconds: float) -> None:
+        self._request_phase.labels(
+            phase=phase, criticality=criticality).observe(
+            max(0.0, seconds))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

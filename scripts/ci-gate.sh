@@ -31,6 +31,12 @@ python -m pytest tests/test_lifecycle.py -q
 # stream breaks — restore-or-recompute resume, offset dedupe, breaker
 # exclusion, and the LLMD_STREAM_RESUME=0 fail-fast contract.
 python -m pytest tests/test_stream_recovery.py -q
+# llmd-trace gate (end-to-end request tracing): connected span trees
+# across the sim stack, resume-attempt spans under the original trace
+# id with zero orphans after a seeded engine kill, the TTFT
+# decomposition summing to measured TTFT within 5%, sampling knobs,
+# the TRACE coverage rules, and the no-host-sync JIT meta-guard.
+python -m pytest tests/test_tracing.py -q
 # int8 paged-KV contract fail-fast (kv_cache_dtype=int8: kernel/fallback
 # parity bounds, offload scale round-trip, wire dtype rejection, pool
 # sizing): a silent KV-numerics or wire-format break must not merge.
@@ -49,4 +55,5 @@ python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_mla_quant.py \
     --ignore=tests/test_collective_quant.py \
     --ignore=tests/test_stream_recovery.py \
-    --ignore=tests/test_llmd_race.py
+    --ignore=tests/test_llmd_race.py \
+    --ignore=tests/test_tracing.py

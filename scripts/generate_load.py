@@ -22,6 +22,13 @@ Examples:
       # SSE streams with the continuity oracle: stream_breaks and
       # continuity_errors in the summary must be 0 under mid-stream
       # recovery chaos (see docs/resilience.md)
+  python scripts/generate_load.py --url http://gw:8000 --qps 10 \
+      --trace-export /tmp/run.jsonl
+      # post-run: scrape /debug/traces from the gateway (and any
+      # --trace-urls), write the span JSONL, and append the llmd-trace
+      # per-phase attribution table (p50/p99 per SLO class) to the
+      # summary — TTFT decomposition instead of eyeballed math
+      # (analyze further with scripts/trace_report.py)
 
 Client-side fault kinds (--faults kind:rate[,kind:rate...], mirroring the
 reference error-injection load script):
@@ -43,6 +50,9 @@ import time
 import aiohttp
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+import trace_report  # noqa: E402  (sibling script: the span analyzer)
 
 from llm_d_tpu.server.stream_resume import (  # noqa: E402
     parse_stream_payload,
@@ -251,7 +261,43 @@ async def run(args) -> None:
     if args.stream:
         summary["stream_breaks"] = breaks
         summary["continuity_errors"] = cont_errors
+    if args.trace_export:
+        summary["trace"] = await export_traces(args)
     print(json.dumps(summary))
+
+
+async def export_traces(args) -> dict:
+    """Post-run llmd-trace scrape: fetch /debug/traces from every trace
+    URL, write the merged JSONL to --trace-export, and fold the spans
+    into the per-phase attribution summary (p50/p99 per SLO class) plus
+    the aggregate TTFT decomposition — the load report's latency numbers
+    become attributable instead of eyeballed."""
+    urls = [u.strip().rstrip("/") for u in
+            (args.trace_urls or args.url).split(",") if u.strip()]
+    lines = []
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=10)) as session:
+        for u in urls:
+            try:
+                async with session.get(f"{u}/debug/traces") as resp:
+                    if resp.status != 200:
+                        print(f"trace scrape {u}: HTTP {resp.status}",
+                              file=sys.stderr)
+                        continue
+                    text = await resp.text()
+            except aiohttp.ClientError as exc:
+                print(f"trace scrape {u} failed: {exc}", file=sys.stderr)
+                continue
+            lines.extend(text.splitlines())
+    # One parse over all URLs' lines: load_trace_lines dedupes by
+    # (trace, span) id, covering components that share one process.
+    spans = trace_report.load_trace_lines(lines)
+    with open(args.trace_export, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+    report = trace_report.build_report(spans, by_class=True)
+    report["exported_to"] = args.trace_export
+    return report
 
 
 def main() -> None:
@@ -289,6 +335,16 @@ def main() -> None:
                          "(missing [DONE]) and continuity_errors "
                          "(duplicated/missing token indices) — both must "
                          "be 0 under mid-stream recovery chaos")
+    ap.add_argument("--trace-export", default=None,
+                    help="post-run: scrape /debug/traces from the trace "
+                         "URLs, write the span JSONL here, and append "
+                         "the per-phase (p50/p99 per SLO class) "
+                         "attribution + TTFT decomposition to the "
+                         "summary")
+    ap.add_argument("--trace-urls", default=None,
+                    help="comma list of base URLs to scrape traces from "
+                         "(default: --url; add model-server/sidecar "
+                         "URLs when they run in separate processes)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
     args.fault_map = parse_faults(args.faults)

@@ -1,0 +1,738 @@
+"""llmd-trace: end-to-end request tracing with per-phase attribution.
+
+Covers the span layer (ids, headers, sampling, ring buffers), the
+llmd-check TRACE coverage rules (seeded violation + fixed twin, real
+tree clean), the sim-stack integration (connected parent/child tree
+across gateway -> replicas, x-request-id as the trace seed), the chaos
+acceptance bar (a seeded mid-stream ``engine.step`` kill produces
+resume-attempt spans under the ORIGINAL trace id with zero orphans, and
+``trace_report``'s TTFT decomposition sums to the measured TTFT within
+5%), the engine guard (tracing adds no host sync to ``EngineCore.step``
+— the JIT pass meta-gate), and the load tool's ``--trace-export``
+scrape.  All CPU, tier-1 safe.
+"""
+
+import asyncio
+import importlib.util
+import json
+import pathlib
+import socket
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+from llm_d_tpu.analysis.core import Baseline, Context, run_passes  # noqa: E402
+from llm_d_tpu.analysis.passes.jit_hygiene import JitHygienePass  # noqa: E402
+from llm_d_tpu.analysis.passes.trace import TracePass  # noqa: E402
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore  # noqa: E402
+from llm_d_tpu.engine.request import Request  # noqa: E402
+from llm_d_tpu.epp.datastore import EndpointState  # noqa: E402
+from llm_d_tpu.ops.sampling import SamplingParams  # noqa: E402
+from llm_d_tpu.server.stream_resume import parse_stream_payload  # noqa: E402
+from llm_d_tpu.sim.simulator import SimConfig, build_sim_server  # noqa: E402
+from llm_d_tpu.utils import tracing  # noqa: E402
+from llm_d_tpu.utils.faultinject import (  # noqa: E402
+    FaultInjector,
+    install,
+    reset as fault_reset,
+)
+from llm_d_tpu.utils.lifecycle import (  # noqa: E402
+    REQUEST_ID_HEADER,
+    TRACE_ID_HEADER,
+    TRACE_PARENT_HEADER,
+    TRACE_SAMPLED_HEADER,
+    TRACEPARENT_HEADER,
+)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO / "scripts" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace_report = _load_script("trace_report")
+
+
+@pytest.fixture(autouse=True)
+def _isolate_tracing(monkeypatch):
+    """Fresh tracer registry per test; tracing fully on."""
+    monkeypatch.delenv("LLMD_TRACE", raising=False)
+    monkeypatch.delenv("LLMD_TRACE_SAMPLE", raising=False)
+    monkeypatch.delenv("LLMD_TRACE_BUFFER", raising=False)
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+@pytest.fixture()
+def inject():
+    def make(spec: str = "", seed: int = 0) -> FaultInjector:
+        return install(FaultInjector.from_spec(spec, seed=seed))
+    yield make
+    fault_reset()
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _start_app(app, port):
+    from aiohttp import web
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner
+
+
+# ---------------------------------------------------------------------------
+# units: ids, headers, sampling, rings
+# ---------------------------------------------------------------------------
+
+def test_trace_id_seeds_deterministically_from_request_id():
+    a = tracing.trace_id_from_request_id("req-abc123")
+    b = tracing.trace_id_from_request_id("req-abc123")
+    c = tracing.trace_id_from_request_id("req-other")
+    assert a == b and a != c and len(a) == 32
+    t = tracing.Tracer("t")
+    span = t.start_span("x", request_id="req-abc123")
+    assert span.trace_id == a
+
+
+def test_header_roundtrip_and_precedence():
+    t = tracing.Tracer("t")
+    span = t.start_span("root", request_id="req-1")
+    hdrs = tracing.trace_headers(span.ctx())
+    assert hdrs[TRACEPARENT_HEADER] == \
+        f"00-{span.trace_id}-{span.span_id}-01"
+    assert hdrs[TRACE_ID_HEADER] == span.trace_id
+    assert hdrs[TRACE_PARENT_HEADER] == span.span_id
+    assert hdrs[TRACE_SAMPLED_HEADER] == "1"
+    ctx = tracing.parse_trace_headers(hdrs)
+    assert ctx.trace_id == span.trace_id
+    assert ctx.span_id == span.span_id
+    assert ctx.sampled
+    # W3C traceparent alone parses too (interop path).
+    w3c_only = {TRACEPARENT_HEADER: hdrs[TRACEPARENT_HEADER]}
+    ctx2 = tracing.parse_trace_headers(w3c_only)
+    assert ctx2.trace_id == span.trace_id
+    # The pinned trio wins over a conflicting traceparent.
+    mixed = dict(hdrs)
+    mixed[TRACEPARENT_HEADER] = f"00-{'f' * 32}-{'e' * 16}-01"
+    assert tracing.parse_trace_headers(mixed).trace_id == span.trace_id
+    # No headers at all -> None (this hop becomes the root).
+    assert tracing.parse_trace_headers({}) is None
+
+
+def test_child_spans_stay_in_trace_and_parent_correctly():
+    t = tracing.Tracer("a")
+    u = tracing.Tracer("b")
+    root = t.start_span("root", request_id="req-1")
+    child = u.start_span("child", parent=root.ctx())
+    grand = u.start_span("grand", parent=child)
+    assert child.trace_id == root.trace_id == grand.trace_id
+    assert child.parent_id == root.span_id
+    assert grand.parent_id == child.span_id
+    grand.end()
+    child.end()
+    root.end()
+    spans = t.snapshot() + u.snapshot()
+    assert {s["span"] for s in spans} == \
+        {root.span_id, child.span_id, grand.span_id}
+    assert trace_report.find_orphans(spans) == []
+
+
+def test_sampling_honors_llmd_trace_sample(monkeypatch):
+    t = tracing.Tracer("t")
+    monkeypatch.setenv("LLMD_TRACE_SAMPLE", "0.0")
+    s = t.start_span("x", request_id="req-1")
+    s.add_event("e")
+    s.end()
+    assert t.snapshot() == []              # nothing recorded
+    assert not s.sampled
+    # The verdict propagates: a downstream hop with the parent ctx
+    # records nothing either, even at rate 1.0.
+    monkeypatch.setenv("LLMD_TRACE_SAMPLE", "1.0")
+    child = t.start_span("y", parent=s.ctx())
+    child.end()
+    assert t.snapshot() == []
+    # Full sampling records.
+    s2 = t.start_span("x2", request_id="req-1")
+    s2.end()
+    assert len(t.snapshot()) == 1
+    # Deterministic per-id verdict at a mid rate: same id -> same answer.
+    monkeypatch.setenv("LLMD_TRACE_SAMPLE", "0.5")
+    verdicts = {tracing.Tracer("v").start_span(
+        "z", request_id=f"req-{i}").sampled for i in range(64)}
+    assert verdicts == {True, False}       # rate is actually partial
+    for i in range(8):
+        a = tracing.Tracer("v1").start_span("z", request_id=f"req-{i}")
+        b = tracing.Tracer("v2").start_span("z", request_id=f"req-{i}")
+        assert a.sampled == b.sampled
+
+
+def test_unparented_events_bypass_sampling(monkeypatch):
+    """Component-level facts (fault firings, breaker flips) must record
+    whenever tracing is on — a sampled-out chaos run would otherwise
+    lose its causal backstop exactly at the interesting events."""
+    monkeypatch.setenv("LLMD_TRACE_SAMPLE", "0.0")
+    tracing.trace_event("fault", "fault.engine.step", key="sim-0")
+    assert [s["name"] for s in tracing.get_tracer("fault").snapshot()] \
+        == ["fault.engine.step"]
+    # A PARENTED event still follows the request's verdict.
+    t = tracing.Tracer("t")
+    root = t.start_span("r", request_id="req-1")       # unsampled at 0.0
+    t.event_span("child-ev", parent=root)
+    assert t.snapshot() == []
+
+
+def test_llmd_trace_master_switch(monkeypatch):
+    monkeypatch.setenv("LLMD_TRACE", "0")
+    t = tracing.Tracer("t")
+    s = t.start_span("x", request_id="req-1")
+    s.end()
+    tracing.trace_event("t", "ev")
+    assert t.snapshot() == []
+    assert tracing.get_tracer("t").snapshot() == []
+
+
+def test_ring_buffer_bounded_by_llmd_trace_buffer(monkeypatch):
+    monkeypatch.setenv("LLMD_TRACE_BUFFER", "4")
+    t = tracing.Tracer("t")
+    assert t.capacity == 4
+    for i in range(10):
+        t.start_span(f"s{i}", request_id="req-1").end()
+    kept = t.snapshot()
+    assert len(kept) == 4
+    assert [s["name"] for s in kept] == ["s6", "s7", "s8", "s9"]
+    assert t.recorded == 10
+    # Drain empties; export appends JSONL.
+    assert len(t.drain()) == 4 and t.snapshot() == []
+
+
+def test_export_jsonl_and_report_roundtrip(tmp_path):
+    t = tracing.get_tracer("t")
+    root = t.start_span("root", request_id="req-1", criticality="critical")
+    t.record_span("work", root.ts, root.ts + 0.25, parent=root,
+                  phase="prefill")
+    root.add_event("first_token")
+    root.end()
+    path = tmp_path / "trace.jsonl"
+    n = tracing.export_all_jsonl(str(path))
+    assert n == 2
+    spans = trace_report.load_trace_file(str(path))
+    assert len(spans) == 2
+    table = trace_report.phase_attribution(spans, by_class=True)
+    assert table["critical"]["prefill"]["n"] == 1
+    assert table["critical"]["prefill"]["p50_s"] == pytest.approx(
+        0.25, abs=0.01)
+
+
+def test_ttft_decomposition_on_synthetic_trace():
+    t0 = 1000.0
+    spans = [
+        {"trace": "T", "span": "r", "parent": None, "component": "gw",
+         "name": "gateway.request", "ts": t0, "dur": 1.0,
+         "attrs": {"criticality": "standard"},
+         "events": [{"ts": t0 + 0.5, "name": "first_token"}]},
+        {"trace": "T", "span": "q", "parent": "r", "component": "gw",
+         "name": "gateway.queue", "ts": t0 + 0.01, "dur": 0.09,
+         "attrs": {"phase": "queue"}},
+        {"trace": "T", "span": "s", "parent": "r", "component": "gw",
+         "name": "gateway.schedule", "ts": t0 + 0.1, "dur": 0.1,
+         "attrs": {"phase": "schedule"}},
+        {"trace": "T", "span": "p", "parent": "r", "component": "sim",
+         "name": "sim.prefill", "ts": t0 + 0.2, "dur": 0.29,
+         "attrs": {"phase": "prefill"}},
+        # Decode is TPOT territory: never part of the TTFT split.
+        {"trace": "T", "span": "d", "parent": "r", "component": "sim",
+         "name": "sim.decode", "ts": t0 + 0.5, "dur": 0.5,
+         "attrs": {"phase": "decode"}},
+    ]
+    d = trace_report.ttft_decomposition(spans)
+    assert d["measured_ttft_s"] == pytest.approx(0.5)
+    assert d["phases_s"]["queue"] == pytest.approx(0.09)
+    assert d["phases_s"]["schedule"] == pytest.approx(0.1)
+    assert d["phases_s"]["prefill"] == pytest.approx(0.29)
+    assert "decode" not in d["phases_s"]
+    assert d["attributed_s"] + d["other_s"] == pytest.approx(
+        d["measured_ttft_s"], abs=1e-6)
+    assert d["other_s"] / d["measured_ttft_s"] < 0.05
+
+
+# ---------------------------------------------------------------------------
+# llmd-check TRACE rules: seeded violation + fixed twin, real tree clean
+# ---------------------------------------------------------------------------
+
+def mini_repo(tmp_path, files):
+    for sub in ("llm_d_tpu", "scripts", "tests", "docs", "deploy"):
+        (tmp_path / sub).mkdir(exist_ok=True)
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    return Context(tmp_path)
+
+
+def test_trace001_fault_point_without_emission(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/hop.py": '''
+            from llm_d_tpu.utils.faultinject import get_injector
+
+            async def forward(key):
+                await get_injector().acheck("gateway.forward", key=key)
+                return 1
+        ''',
+    })
+    findings = TracePass().run(ctx)
+    assert [f.rule for f in findings] == ["TRACE001"]
+    assert "gateway.forward" in findings[0].message
+
+
+def test_trace001_fixed_twin_emission_silences(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/hop.py": '''
+            from llm_d_tpu.utils import tracing
+            from llm_d_tpu.utils.faultinject import get_injector
+
+            async def forward(key, span):
+                span.add_event("forward", key=key)
+                await get_injector().acheck("gateway.forward", key=key)
+                return 1
+        ''',
+    })
+    assert TracePass().run(ctx) == []
+
+
+def test_trace001_nested_def_emission_does_not_count(tmp_path):
+    """An emission inside a nested callback proves nothing about the
+    enclosing fault path (walk_excluding_nested_defs doctrine)."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/hop.py": '''
+            from llm_d_tpu.utils.faultinject import get_injector
+
+            def pull(key, span):
+                def on_done():
+                    span.add_event("done")
+                get_injector().check("kv.pull", key=key)
+                return on_done
+        ''',
+    })
+    assert [f.rule for f in TracePass().run(ctx)] == ["TRACE001"]
+
+
+def test_trace002_retry_resume_paths_must_emit(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/relay.py": '''
+            async def pump(journal, targets):
+                for t in targets:
+                    journal.resume_count += 1
+                    journal.mark_break()
+                return None
+
+            async def prefill_failover(prefillers):
+                for p in prefillers:
+                    pass
+        ''',
+    })
+    findings = TracePass().run(ctx)
+    assert [f.rule for f in findings] == ["TRACE002", "TRACE002"]
+    # marker-based finding anchors at the marker, name-based at the def
+    assert "resume_count" in findings[0].message
+    assert "prefill_failover" in findings[1].message
+
+
+def test_trace002_fixed_twin_and_sync_helper_exempt(tmp_path):
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/relay.py": '''
+            async def pump(journal, targets, span):
+                for t in targets:
+                    journal.resume_count += 1
+                    journal.mark_break()
+                    span.add_event("resume", target=t)
+                return None
+
+            def resume_policy():
+                """Sync config helper: not a recovery path."""
+                return {"enabled": True}
+        ''',
+    })
+    assert TracePass().run(ctx) == []
+
+
+def test_trace002_defers_to_trace001_on_fault_functions(tmp_path):
+    """A function with BOTH a fault point and retry markers reports once
+    (TRACE001), not twice."""
+    ctx = mini_repo(tmp_path, {
+        "llm_d_tpu/relay.py": '''
+            from llm_d_tpu.utils.faultinject import get_injector
+
+            async def resume_stream(journal):
+                journal.resume_count += 1
+                await get_injector().acheck("gateway.forward")
+        ''',
+    })
+    assert [f.rule for f in TracePass().run(ctx)] == ["TRACE001"]
+
+
+def test_trace_pass_real_tree_clean():
+    """Coverage gate: every real fault point and retry/resume path in
+    the package emits a span event (suppressions honored)."""
+    ctx = Context(REPO)
+    baseline = Baseline(REPO / ".llmd-check-baseline.json")
+    findings, _, _ = run_passes(ctx, [TracePass()], baseline=baseline)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_jit_pass_meta_gate_tracing_adds_no_host_sync():
+    """The acceptance guard: with tracing threaded through the engine,
+    the JIT host-sync pass still reports NOTHING beyond the two
+    suppressed deliberate sync points — recording spans never syncs."""
+    ctx = Context(REPO)
+    findings, suppressed, _ = run_passes(ctx, [JitHygienePass()])
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert suppressed >= 2      # the two documented sync points remain
+
+
+# ---------------------------------------------------------------------------
+# engine: spans at step boundaries, no behavior change
+# ---------------------------------------------------------------------------
+
+ENGINE_KW = dict(model="tiny", block_size=4, num_blocks=64, max_num_seqs=8,
+                 max_num_batched_tokens=64, min_token_bucket=16,
+                 min_seq_bucket=4)
+
+
+def _greedy(rid, prompt, n=6):
+    return Request(request_id=rid, prompt_token_ids=list(prompt),
+                   sampling=SamplingParams(temperature=0.0, max_tokens=n,
+                                           ignore_eos=True))
+
+
+def test_engine_records_phase_spans_and_output_is_unchanged():
+    eng = EngineCore(EngineConfig(**ENGINE_KW))
+    root = tracing.get_tracer("server").start_span(
+        "server.request", request_id="req-eng", criticality="standard")
+    traced = _greedy("traced", [1, 2, 3, 4, 5])
+    traced.trace_ctx = root.ctx()
+    plain = _greedy("plain", [1, 2, 3, 4, 5])
+    out = eng.generate([traced, plain])
+    root.end()
+    # Tracing must not perturb compute: identical prompts, identical ids.
+    assert out["traced"] == out["plain"] and len(out["traced"]) == 6
+    spans = tracing.get_tracer("engine").snapshot()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert "engine.queue" in by_name
+    assert "engine.prefill" in by_name
+    assert "engine.decode" in by_name
+    assert "engine.step" in by_name
+    # Every engine span joined the request's trace, none orphaned.
+    all_spans = spans + tracing.get_tracer("server").snapshot()
+    req_spans = [s for s in all_spans if s["trace"] == root.trace_id]
+    assert trace_report.find_orphans(req_spans) == []
+    # The UNTRACED request produced no per-request engine spans.
+    assert not any((s.get("attrs") or {}).get("request_id") == "plain"
+                   for s in spans)
+    # Phase histogram bridge saw the phases for BOTH requests.
+    text = eng.metrics.render().decode()
+    assert 'llmd_tpu:request_phase_seconds_count{' in text
+    assert 'phase="prefill"' in text and 'phase="decode"' in text
+
+
+def test_engine_tracing_off_records_nothing(monkeypatch):
+    monkeypatch.setenv("LLMD_TRACE", "0")
+    eng = EngineCore(EngineConfig(**ENGINE_KW))
+    req = _greedy("r", [1, 2, 3])
+    req.trace_ctx = tracing.TraceContext("a" * 32, "b" * 16, True)
+    out = eng.generate([req])
+    assert len(out["r"]) == 6
+    assert tracing.get_tracer("engine").snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# sim stack: connected tree, x-request-id seed, chaos + TTFT acceptance
+# ---------------------------------------------------------------------------
+
+async def _sim_fleet(n, ttft_ms=1.0, tpot_ms=2.0):
+    from llm_d_tpu.epp.service import build_gateway
+    ports = [free_port() for _ in range(n)]
+    runners, sims = [], []
+    for i in range(n):
+        srv = build_sim_server(SimConfig(
+            model=f"sim-{i}", ttft_ms=ttft_ms, tpot_ms=tpot_ms))
+        sims.append(srv.sim)
+        runners.append(await _start_app(srv.build_app(), ports[i]))
+    endpoints = [EndpointState(address=f"127.0.0.1:{p}") for p in ports]
+    gw = build_gateway(endpoints, scrape_interval_s=0.05, retry_attempts=3)
+    gw_port = free_port()
+    gw_runner = await _start_app(gw.build_app(), gw_port)
+    for _ in range(200):
+        if all(e.ready for e in gw.datastore.candidates()):
+            break
+        await asyncio.sleep(0.02)
+    assert all(e.ready for e in gw.datastore.candidates())
+    return runners, sims, gw, gw_runner, f"http://127.0.0.1:{gw_port}"
+
+
+async def _cleanup(runners):
+    for r in runners:
+        try:
+            await r.cleanup()
+        except Exception:
+            pass
+
+
+def _request_traces(spans):
+    """trace id -> spans, for traces rooted at a gateway.request span."""
+    traces = trace_report.group_traces(spans)
+    return {tid: t for tid, t in traces.items()
+            if any(s["name"] == "gateway.request" for s in t)}
+
+
+def test_sim_stack_connected_tree_and_request_id_seed(inject):
+    inject()       # empty injector: healthy run
+
+    async def run():
+        import aiohttp
+        runners, sims, gw, gw_runner, base = await _sim_fleet(3)
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=20)) as sess:
+                async with sess.post(f"{base}/v1/completions", json={
+                        "prompt": "trace me please", "max_tokens": 4,
+                        "stream": True}) as r:
+                    assert r.status == 200
+                    payload = await r.read()
+            _text, _metas, done = parse_stream_payload(payload)
+            assert done
+            spans = tracing.snapshot_all()
+            reqs = _request_traces(spans)
+            assert len(reqs) == 1
+            tid, tspans = next(iter(reqs.items()))
+            # Connected parent/child tree: exactly one root, no orphans.
+            roots = [s for s in tspans if not s.get("parent")]
+            assert len(roots) == 1 and roots[0]["name"] == "gateway.request"
+            assert trace_report.find_orphans(tspans) == []
+            # Every layer is present in the one tree.
+            comps = {s["component"] for s in tspans}
+            assert {"gateway", "sim"} <= comps
+            names = {s["name"] for s in tspans}
+            assert {"gateway.queue", "gateway.schedule", "gateway.forward",
+                    "sim.request", "sim.queue", "sim.prefill",
+                    "sim.decode"} <= names
+            # x-request-id contract: the gateway MINTED the id, it
+            # reached the replica (sim span attrs), and it seeds the
+            # trace id — logs and traces join on one key.
+            rid = (roots[0].get("attrs") or {}).get("request_id")
+            assert rid and rid.startswith("req-")
+            assert tid == tracing.trace_id_from_request_id(rid)
+            sim_req = next(s for s in tspans if s["name"] == "sim.request")
+            assert (sim_req.get("attrs") or {}).get("request_id") == rid
+            # first_token marked at the relay (TTFT closure point).
+            assert trace_report.first_token_ts(tspans) is not None
+        finally:
+            await _cleanup(runners + [gw_runner])
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+def test_request_id_header_propagates_verbatim(inject):
+    """A client-supplied x-request-id is NOT re-minted: it seeds the
+    trace and rides to the replica unchanged."""
+    inject()
+
+    async def run():
+        import aiohttp
+        runners, sims, gw, gw_runner, base = await _sim_fleet(2)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                        f"{base}/v1/completions",
+                        json={"prompt": "hi", "max_tokens": 2},
+                        headers={REQUEST_ID_HEADER: "req-client-42"}) as r:
+                    assert r.status == 200
+                    body = await r.json()
+            assert body["id"] == "req-client-42"
+            reqs = _request_traces(tracing.snapshot_all())
+            assert list(reqs) == [
+                tracing.trace_id_from_request_id("req-client-42")]
+        finally:
+            await _cleanup(runners + [gw_runner])
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+def test_chaos_kill_resume_spans_under_original_trace(inject):
+    """THE acceptance bar: a seeded mid-stream engine kill produces a
+    trace whose spans form ONE connected tree from gateway admission
+    through the resumed decode — resume-attempt spans under the
+    original trace id, zero orphans — and the TTFT decomposition sums
+    to the measured end-to-end TTFT within 5%."""
+    inj = inject()
+    inj.add_rule("engine.step", after=2, count=1)
+
+    async def run():
+        import aiohttp
+        runners, sims, gw, gw_runner, base = await _sim_fleet(
+            3, ttft_ms=150.0, tpot_ms=2.0)
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=30)) as sess:
+                async with sess.post(f"{base}/v1/completions", json={
+                        "prompt": "recover and attribute me",
+                        "max_tokens": 8, "stream": True}) as r:
+                    assert r.status == 200
+                    payload = await r.read()
+            _text, metas, done = parse_stream_payload(payload)
+            assert done, "stream did not complete through the resume"
+            assert len([i for i, s in enumerate(sims) if s.dead]) == 1
+            spans = tracing.snapshot_all()
+            reqs = _request_traces(spans)
+            assert len(reqs) == 1
+            tid, tspans = next(iter(reqs.items()))
+            # Resume attempt under the ORIGINAL trace id...
+            resumes = [s for s in tspans if s["name"] == "gateway.resume"]
+            assert resumes, "no resume-attempt span in the trace"
+            assert all(s["trace"] == tid for s in resumes)
+            # ...with the resumed replica's spans parented on it.
+            rspan = resumes[0]["span"]
+            resumed_children = [s for s in tspans
+                                if s.get("parent") == rspan]
+            assert any(s["name"] == "sim.request"
+                       for s in resumed_children)
+            # ONE connected tree, zero orphans, one root.
+            assert trace_report.find_orphans(tspans) == []
+            assert len([s for s in tspans if not s.get("parent")]) == 1
+            # The kill itself is causally visible: the dying sim span
+            # carries the fault event.
+            assert any(ev.get("name") == "fault.engine.step"
+                       for s in tspans for ev in s.get("events") or ())
+            # ...and the injector's component-level backstop fired too.
+            assert any(s["component"] == "fault"
+                       and s["name"] == "fault.engine.step"
+                       for s in spans)
+            # TTFT decomposition: attributed phases cover the measured
+            # TTFT within 5% (the 150 ms sim prefill dominates; queue +
+            # schedule + prefill legs must tile the window).
+            d = trace_report.ttft_decomposition(tspans)
+            assert d is not None
+            assert d["measured_ttft_s"] >= 0.10
+            assert d["phases_s"].get("prefill", 0.0) > 0.05
+            assert d["other_s"] <= 0.05 * d["measured_ttft_s"], d
+        finally:
+            await _cleanup(runners + [gw_runner])
+
+    asyncio.run(asyncio.wait_for(run(), timeout=90))
+
+
+def test_sampling_zero_disables_stack_tracing(inject):
+    """LLMD_TRACE_SAMPLE=0: the stack serves identically but records no
+    request spans anywhere."""
+    inject()
+
+    async def run():
+        import aiohttp
+        runners, sims, gw, gw_runner, base = await _sim_fleet(2)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(f"{base}/v1/completions", json={
+                        "prompt": "hi", "max_tokens": 2,
+                        "stream": True}) as r:
+                    assert r.status == 200
+                    await r.read()
+            assert _request_traces(tracing.snapshot_all()) == {}
+        finally:
+            await _cleanup(runners + [gw_runner])
+
+    import os
+    os.environ["LLMD_TRACE_SAMPLE"] = "0.0"
+    try:
+        asyncio.run(asyncio.wait_for(run(), timeout=60))
+    finally:
+        del os.environ["LLMD_TRACE_SAMPLE"]
+
+
+# ---------------------------------------------------------------------------
+# /debug/traces + generate_load --trace-export
+# ---------------------------------------------------------------------------
+
+def test_debug_traces_endpoint_and_load_tool_export(tmp_path, inject):
+    inject()
+
+    async def run():
+        import sys
+        import aiohttp
+        sys.path.insert(0, str(REPO / "scripts"))
+        import generate_load as gl
+        runners, sims, gw, gw_runner, base = await _sim_fleet(2)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                for _ in range(3):
+                    async with sess.post(f"{base}/v1/completions", json={
+                            "prompt": "load me", "max_tokens": 2,
+                            "stream": True}) as r:
+                        assert r.status == 200
+                        await r.read()
+                # The endpoint serves parseable JSONL.
+                async with sess.get(f"{base}/debug/traces") as r:
+                    assert r.status == 200
+                    text = await r.text()
+            spans = trace_report.load_trace_lines(text.splitlines())
+            assert spans and _request_traces(spans)
+            # The load tool's post-run export writes the file and folds
+            # the per-class attribution + TTFT split into its summary.
+            out = tmp_path / "run.jsonl"
+            args = gl.argparse.Namespace(
+                url=base, trace_urls=None, trace_export=str(out))
+            report = await gl.export_traces(args)
+            assert out.exists()
+            assert report["traces"] >= 3
+            assert report["orphan_spans"] == 0
+            att = report["phase_attribution"]
+            assert "standard" in att
+            assert {"queue", "schedule", "prefill"} <= set(att["standard"])
+            for row in att["standard"].values():
+                assert "p50_s" in row and "p99_s" in row
+            assert report["ttft"]["n"] >= 3
+        finally:
+            await _cleanup(runners + [gw_runner])
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+def test_trace_report_cli_smoke(tmp_path):
+    t = tracing.get_tracer("cli")
+    root = t.start_span("gateway.request", request_id="req-cli",
+                        criticality="standard")
+    t.record_span("gateway.schedule", root.ts, root.ts + 0.01,
+                  parent=root, phase="schedule")
+    root.add_event("first_token")
+    root.end()
+    path = tmp_path / "t.jsonl"
+    tracing.export_all_jsonl(str(path))
+    import subprocess
+    import sys as _sys
+    out = subprocess.run(
+        [_sys.executable, str(REPO / "scripts" / "trace_report.py"),
+         str(path), "--by-class", "--waterfalls", "1"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "schedule" in out.stdout
+    assert "trace " in out.stdout            # waterfall rendered
+    js = subprocess.run(
+        [_sys.executable, str(REPO / "scripts" / "trace_report.py"),
+         str(path), "--json"],
+        capture_output=True, text=True, timeout=60)
+    report = json.loads(js.stdout)
+    assert report["traces"] == 1 and report["orphan_spans"] == 0
