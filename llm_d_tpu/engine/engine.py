@@ -37,11 +37,15 @@ from llm_d_tpu.ops.quant import (
     KV_CACHE_DTYPES, KV_SCALE_GRANULARITIES, MLA_LATENT_DTYPES,
     kv_scale_width)
 from llm_d_tpu.utils import tracing
-from llm_d_tpu.utils.config import env_choice
+from llm_d_tpu.utils.config import env_choice, env_int
 from llm_d_tpu.utils.faultinject import get_injector
 from llm_d_tpu.utils.metrics import EngineMetrics
 
 logger = logging.getLogger(__name__)
+
+# Speculative-decode master modes (LLMD_SPEC_DECODE): "auto" = run the
+# draft+verify program whenever spec_k > 0, "off" = kill switch.
+SPEC_DECODE_MODES = ("auto", "off")
 
 
 def _next_bucket(n: int, lo: int, hi: int) -> int:
@@ -160,6 +164,24 @@ class EngineConfig:
     # the r5 harness covered decode only).  Values: "attn", "moe_ffn",
     # "shared_expert".  Changes model output — bench/diagnostics only.
     stub_components: Tuple[str, ...] = ()
+    # Speculative decoding (MTP draft-and-verify): "auto" runs the fused
+    # draft+verify program on pure-decode rounds whenever spec_k > 0;
+    # "off" is a kill switch that restores today's engine byte for byte.
+    # None resolves LLMD_SPEC_DECODE.
+    spec_decode: Optional[str] = None
+    # Draft tokens per step (K).  0 = spec decode off (the shipped
+    # default: nothing changes until an operator opts in).  None resolves
+    # LLMD_SPEC_K; the --spec-k server flag sets it explicitly.  The
+    # engine schedules up to K+1 tokens per sequence per decode step and
+    # rolls rejected KV back the same step; output stays byte-identical
+    # to non-spec decode for greedy and seeded sampling.
+    spec_k: Optional[int] = None
+    # Bench/diagnostics only (like stub_components): replace draft
+    # verification with a SEEDED per-draft acceptance coin at this rate,
+    # so accepted-tok/s is measurable at a controlled acceptance whatever
+    # the drafter's real hit rate on random-init weights.  Changes model
+    # output — never set on a serving path.
+    spec_fixed_accept: Optional[float] = None
 
     def resolve_model(self) -> ModelConfig:
         return self.model_config or get_config(self.model)
@@ -423,6 +445,52 @@ class EngineCore:
         self._inflight: Optional[Dict[str, Any]] = None
         # Stacked mode: EPLB valid-token mask for the last built batch.
         self._routed_valid: Optional[np.ndarray] = None
+
+        # --- speculative decoding (MTP draft-and-verify) ---
+        # Resolution: the master mode must be "auto" AND a positive K
+        # configured (config/LLMD_SPEC_K/--spec-k) — the shipped default
+        # K of 0 keeps the engine byte-identical to the pre-spec one.
+        spec_mode = config.spec_decode or env_choice(
+            "LLMD_SPEC_DECODE", "auto", SPEC_DECODE_MODES)
+        if spec_mode not in SPEC_DECODE_MODES:
+            raise ValueError(f"unknown spec_decode {spec_mode!r} "
+                             f"(choices: {SPEC_DECODE_MODES})")
+        spec_k = (config.spec_k if config.spec_k is not None
+                  else env_int("LLMD_SPEC_K", 0))
+        self.spec_k = 0
+        self.draft_params = None
+        self.spec_tracker = None
+        self._spec_fn = None
+        if spec_mode != "off" and spec_k > 0:
+            # Composition gates: spec decode owns the multi-token decode
+            # step, so the fused-multistep/async pipeline and the spec
+            # program are per-engine alternatives; stacked dp and EPLB
+            # integration are future work (the refactor they need —
+            # variable tokens-per-step through scheduler/KV/sampling —
+            # lands here either way).
+            blocker = (
+                "async_scheduling/num_scheduler_steps > 1 (the fused "
+                "decode pipeline owns multi-token steps there)"
+                if config.num_scheduler_steps > 1 else
+                "stacked SPMD dp" if self.dp > 1 else
+                "EPLB" if self.eplb is not None else None)
+            if blocker is not None:
+                logger.warning("spec decode requested (K=%d) but disabled: "
+                               "engine uses %s", spec_k, blocker)
+            else:
+                from llm_d_tpu.predictor.model import SpecAcceptanceTracker
+                self.spec_k = int(spec_k)
+                self.draft_params = jax.device_put(
+                    self.model.init_draft_params(
+                        c, jax.random.PRNGKey(config.seed + 1)),
+                    NamedSharding(self.mesh, P()))
+                self.spec_tracker = SpecAcceptanceTracker(self.spec_k)
+                self._spec_fn = self._build_spec_fn(self.spec_k)
+                self.scheduler.spec_lookahead = self._spec_lookahead
+                logger.info("spec decode on: K=%d%s", self.spec_k,
+                            f" (fixed acceptance "
+                            f"{config.spec_fixed_accept})"
+                            if config.spec_fixed_accept is not None else "")
 
         self._step_fn = self._build_step_fn()
         # Variant computing top-N logprobs, compiled on first use (steps
@@ -755,6 +823,7 @@ class EngineCore:
                 finish_reason=finish))
             if finish is not None:
                 self.scheduler.finish(req, RequestState(finish))
+                self._spec_forget(req.request_id)
                 self.metrics.request_success.labels(
                     model_name=self.metrics.model_name,
                     finished_reason=finish).inc()
@@ -848,6 +917,233 @@ class EngineCore:
         meta, ordered, rows = self._ms_meta(sched.scheduled)
         return self._ms_retire(self._ms_dispatch(meta, ordered, K, rows))
 
+    # ---------- speculative decode (MTP draft-and-verify) ----------
+
+    def _spec_lookahead(self, req: Request) -> int:
+        """Draft tokens worth scheduling for this decode entry (the
+        scheduler's spec callback): fresh drafts only, depth from the
+        acceptance tracker's adaptive K, capped so the step can neither
+        run past max_model_len nor draft beyond the request's own
+        max_tokens (those verify FLOPs could never emit)."""
+        sp = req.sampling
+        if sp.logprobs is not None or req.do_remote_decode:
+            return 0
+        if req.spec_drafts_at != req.num_tokens or not req.spec_drafts:
+            return 0                      # stale or absent: plain decode
+        k = min(self.spec_tracker.suggest_k(req.request_id),
+                len(req.spec_drafts), self.spec_k)
+        k = min(k, self.model_config.max_model_len - req.num_tokens - 1)
+        k = min(k, sp.max_tokens - len(req.output_token_ids) - 1)
+        return max(0, k)
+
+    def _build_spec_fn(self, K: int):
+        """One fused draft+verify device program: a single target-model
+        forward over each sequence's K+1 query positions (last accepted
+        token + K drafts — the idle-FLOP spend: decode is HBM-bound, so
+        verifying K extra rows rides the same weight stream), on-device
+        accept/reject + bonus sampling (ops/sampling.spec_verify, seeded
+        rows via fold_in(seed, gen_idx) for byte-identical parity), and
+        the MTP drafter proposing the NEXT step's K drafts from the last
+        accepted position's hidden state.  Only the sampled ids, the
+        accepted counts and the next drafts travel host-ward — in the
+        step's one batched fetch, never a new sync."""
+        c = self.model_config
+        block_size = self.config.block_size
+        backend = self.config.attn_backend
+        model, mesh = self.model, self.mesh
+        moe_opts = self._moe_opts()
+        fixed = self.config.spec_fixed_accept
+        Q = K + 1
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def spec_fn(params, draft_params, kv_cache, batch, rng):
+            hidden, kv_cache = model.forward(
+                params, kv_cache, batch, c, block_size, backend,
+                mesh=mesh, moe_opts=moe_opts)       # [S*Q, D]
+            logits = model.compute_logits(params, hidden, c)
+            ids, accepted = sampling_ops.spec_verify(
+                logits, batch["draft_tokens"], batch["spec_n"],
+                batch["temperature"], batch["top_k"], batch["top_p"],
+                rng, seeds=batch["seeds"], gen0=batch["gen0"],
+                fixed_accept=fixed, step=batch["spec_step"])
+            S = accepted.shape[0]
+            h = hidden.reshape(S, Q, hidden.shape[-1])
+            h_a = jnp.take_along_axis(
+                h, accepted[:, None, None], axis=1)[:, 0]
+            bonus = jnp.take_along_axis(ids, accepted[:, None], axis=1)[:, 0]
+            drafts = model.draft_propose(
+                params, draft_params, h_a, bonus, K, c)
+            return ids, accepted, drafts, kv_cache
+
+        return spec_fn
+
+    def _build_spec_batch(self, scheduled) -> Dict[str, Any]:
+        """Host arrays for a spec round: every sequence gets a fixed K+1
+        query-slot stride (static shapes; S buckets like any batch).  A
+        sequence with fewer live drafts pads the tail of its stride
+        exactly like ordinary ragged-batch padding — trash-slot KV
+        writes, sentinel qtok rows — so the attention path sees a
+        standard chunked-prefill-shaped batch."""
+        cfg = self.config
+        K = self.spec_k
+        Q = K + 1
+        B = self.max_blocks_per_seq
+        bs = cfg.block_size
+        S = _next_bucket(len(scheduled),
+                         min(cfg.min_seq_bucket, cfg.max_num_seqs),
+                         cfg.max_num_seqs)
+        T = S * Q
+        arrs = dict(
+            token_ids=np.zeros(T, np.int32),
+            positions=np.zeros(T, np.int32),
+            token_seq_ids=np.zeros(T, np.int32),
+            token_qpos=np.zeros(T, np.int32),
+            slot_mapping=np.zeros(T, np.int32),   # local block 0 = trash
+            block_tables=np.zeros((S, B), np.int32),
+            seq_lens=np.zeros(S, np.int32),
+            # Verification needs logits at EVERY query position, so the
+            # sample gather covers all T rows (padding rows' logits are
+            # masked by spec_n / discarded host-side).
+            sample_idx=np.arange(T, dtype=np.int32),
+            qtok_idx=np.full((S, Q), T, np.int32),
+            temperature=np.zeros(S, np.float32),
+            top_k=np.zeros(S, np.int32),
+            top_p=np.ones(S, np.float32),
+            seeds=np.full(S, -1, np.int32),
+            gen0=np.zeros(S, np.int32),
+            draft_tokens=np.zeros((S, K), np.int32),
+            spec_n=np.zeros(S, np.int32),
+            spec_step=np.int32(self._step_count),
+        )
+        for s, sr in enumerate(scheduled):
+            req = sr.request
+            nd = sr.num_draft_tokens
+            n = 1 + nd
+            p0 = req.num_computed_tokens
+            t0 = s * Q
+            arrs["token_ids"][t0] = req.all_token_ids[p0]
+            if nd:
+                arrs["token_ids"][t0 + 1:t0 + n] = req.spec_drafts[:nd]
+                arrs["draft_tokens"][s, :nd] = req.spec_drafts[:nd]
+            pos = np.arange(p0, p0 + n)
+            arrs["positions"][t0:t0 + n] = pos
+            arrs["token_seq_ids"][t0:t0 + n] = s
+            arrs["token_qpos"][t0:t0 + n] = np.arange(n)
+            blocks = np.asarray(req.block_ids, np.int32)
+            arrs["slot_mapping"][t0:t0 + n] = \
+                blocks[pos // bs] * bs + pos % bs
+            arrs["block_tables"][s, :len(blocks)] = blocks
+            arrs["seq_lens"][s] = p0 + n
+            arrs["qtok_idx"][s, :n] = np.arange(t0, t0 + n)
+            sp = req.sampling
+            arrs["temperature"][s] = sp.temperature
+            arrs["top_k"][s] = sp.top_k
+            arrs["top_p"][s] = sp.top_p
+            if sp.seed is not None:
+                arrs["seeds"][s] = int(sp.seed) & 0x7FFFFFFF
+            arrs["gen0"][s] = len(req.output_token_ids)
+            arrs["spec_n"][s] = nd
+        return arrs
+
+    def _run_spec(self, sched: SchedulerOutput) -> List[RequestOutput]:
+        """One draft-and-verify engine step over a pure-decode round.
+
+        Emits 1..K+1 tokens per sequence (accepted drafts + the
+        correction/bonus token), rolls rejected tokens' tail blocks back
+        to the pool the same step (kv_cache.trim_request — the prefix
+        cache only ever hashes blocks full of ACCEPTED content, so PR 9
+        restores always land on a clean prefix), and stores the device-
+        proposed next drafts per request."""
+        scheduled = sched.scheduled
+        step_t0 = time.monotonic()
+        batch = jax.device_put(self._build_spec_batch(scheduled),
+                               self._replicated)
+        self._rng, step_key = jax.random.split(self._rng)
+        ids_dev, acc_dev, drafts_dev, self.kv_cache = self._spec_fn(
+            self.params, self.draft_params, self.kv_cache, batch, step_key)
+        # ONE batched fetch, exactly like the classic step's: ids +
+        # accepted counts + next drafts in a single tunnel round trip.
+        # llmd: ignore[JIT] the one intended spec-step host sync (batched)
+        fetched = jax.device_get([ids_dev, acc_dev, drafts_dev])
+        ids, accepted, drafts = (np.asarray(x) for x in fetched)
+        self._step_count += 1
+
+        outputs: List[RequestOutput] = []
+        now = time.monotonic()
+        total_drafted = total_accepted = 0
+        for s, sr in enumerate(scheduled):
+            req = sr.request
+            nd = sr.num_draft_tokens
+            a = min(int(accepted[s]), nd)
+            total_drafted += nd
+            total_accepted += a
+            req.spec_drafted += nd
+            req.spec_accepted += a
+            if nd:
+                self.metrics.spec_draft_tokens.inc(nd)
+                if a:
+                    self.metrics.spec_accepted_tokens.inc(a)
+                self.spec_tracker.observe(req.request_id, nd, a)
+            new_tokens: List[int] = []
+            finish = None
+            for q in range(a + 1):
+                token = int(ids[s, q])
+                req.num_computed_tokens += 1
+                req.output_token_ids.append(token)
+                new_tokens.append(token)
+                finish = self._check_stop(req, token)
+                if finish is not None:
+                    break               # tokens past a stop are discarded
+            self.metrics.generation_tokens.inc(len(new_tokens))
+            # All 1+nd scheduled rows computed (and crossed the EP wire)
+            # whatever the verifier kept.
+            self._account_collective_bytes(1 + nd)
+            if req.last_token_time is not None:
+                self.metrics.inter_token_latency.observe(
+                    (now - req.last_token_time) / max(1, len(new_tokens)))
+            req.last_token_time = now
+            # Next step's drafts (device-proposed); the tag invalidates
+            # them if any non-spec path appends tokens first.  The
+            # adaptive depth is read fresh from the tracker at the next
+            # schedule pass (_spec_lookahead), not cached on the request.
+            req.spec_drafts = [int(t) for t in drafts[s]]
+            req.spec_drafts_at = req.num_tokens
+            self.kv_manager.cache_full_blocks(req)
+            outputs.append(RequestOutput(
+                req.request_id, new_tokens, finish is not None,
+                finish_reason=finish))
+            if finish is not None:
+                self.scheduler.finish(req, RequestState(finish))
+                self._spec_forget(req.request_id)
+                self.metrics.request_success.labels(
+                    model_name=self.metrics.model_name,
+                    finished_reason=finish).inc()
+                self.metrics.e2e_request_latency.observe(
+                    now - req.arrival_time)
+                self._trace_phase(
+                    req, "engine.decode", "decode",
+                    req.first_token_time or now, now,
+                    n_tokens=len(req.output_token_ids), finish=finish)
+            else:
+                # Rejection rollback: tail blocks past the accepted
+                # content (plus the pending token's slot) return to the
+                # pool THIS step.
+                self.kv_manager.trim_request(req, req.num_tokens)
+        # Step-boundary span from the clock reads already bracketing the
+        # one batched fetch — drafted/accepted attribution rides the
+        # span, no extra sync.
+        traced = next((sr.request for sr in scheduled
+                       if sr.request.trace_ctx is not None), None)
+        if traced is not None:
+            self.tracer.record_span(
+                "engine.step", self._mono_to_epoch(step_t0),
+                self._mono_to_epoch(now), parent=traced.trace_ctx,
+                step=self._step_count, kind="decode", spec=True,
+                n_seqs=len(scheduled), drafted=total_drafted,
+                accepted=total_accepted)
+        self._update_queue_metrics()
+        return outputs
+
     # ---------- public API ----------
 
     def add_request(self, request: Request) -> None:
@@ -883,8 +1179,18 @@ class EngineCore:
             return
         self.scheduler.add_request(request)
 
+    def _spec_forget(self, request_id: str) -> None:
+        """Drop a finished request's acceptance-tracker state (no-op with
+        spec off).  Called on EVERY finish path — spec retire, classic
+        and fused retires, scheduler evictions, aborts — so live
+        requests' EMA state is never evicted by stale entries hitting
+        the tracker's bounded-table cap."""
+        if self.spec_tracker is not None:
+            self.spec_tracker.forget(request_id)
+
     def abort_request(self, request_id: str) -> None:
         self.scheduler.abort_request(request_id)
+        self._spec_forget(request_id)
         # Aborting a finished remote-prefill (PD producer) must free the
         # pinned blocks, or the usable cache shrinks permanently.
         req = self.pinned_transfers.pop(request_id, None)
@@ -1085,11 +1391,38 @@ class EngineCore:
         for req in sched.preempted:      # requests finished by the scheduler
             if req.state is RequestState.FINISHED_DEADLINE:
                 self.metrics.inc_deadline_exceeded(req.criticality)
+            self._spec_forget(req.request_id)
             outputs.append(RequestOutput(
                 req.request_id, [], True, finish_reason=req.state.value))
         if sched.empty:
             self._update_queue_metrics()
             return outputs
+
+        if self._spec_fn is not None:
+            # A TRUE decode entry has sampled at least one output token:
+            # without the output_token_ids check a 1-token final prefill
+            # chunk (1-token prompt, or a prompt that chunks to a 1-token
+            # tail) is indistinguishable from decode and would skip the
+            # classic path's first-token bookkeeping (TTFT, prompt/prefix
+            # counters, the engine.prefill trace phase).
+            if all(sr.num_new_tokens == 1
+                   and sr.request.output_token_ids
+                   and sr.request.num_computed_tokens
+                   == sr.request.num_tokens - 1
+                   and not sr.request.do_remote_decode
+                   and sr.request.sampling.logprobs is None
+                   for sr in sched.scheduled):
+                outputs.extend(self._run_spec(sched))
+                return outputs
+            # Mixed round (a prefill chunk or logprobs request joined):
+            # fall back to the classic path and roll back the scheduler's
+            # optimistic draft-token block allocations.
+            for sr in sched.scheduled:
+                if sr.num_draft_tokens:
+                    self.kv_manager.trim_request(
+                        sr.request,
+                        sr.request.num_computed_tokens + sr.num_new_tokens)
+                    sr.num_draft_tokens = 0
 
         K = self._try_multistep(sched)
         if K is not None:
@@ -1212,6 +1545,7 @@ class EngineCore:
             outputs.append(out)
             if finish is not None:
                 self.scheduler.finish(req, RequestState(finish))
+                self._spec_forget(req.request_id)
                 self.metrics.request_success.labels(
                     model_name=self.metrics.model_name,
                     finished_reason=finish).inc()

@@ -309,6 +309,24 @@ class KVCacheManager:
             request.block_ids.pop()
             self._release(b)
 
+    def trim_request(self, request: Request, num_tokens: int) -> int:
+        """Shrink the request's block list to exactly cover ``num_tokens``
+        tokens, releasing the tail — the spec-decode rejection rollback.
+
+        A draft-and-verify step allocates blocks for up to K+1 tokens; the
+        accepted count decides how many were really appended, so the tail
+        blocks past ``ceil(num_tokens / block_size)`` go back to the pool
+        the SAME step (block-boundary-safe: a partially-filled kept block
+        is never released, and released tail blocks were never full, hence
+        never content-hashed — the prefix cache only ever indexes accepted
+        content).  Returns the number of blocks released."""
+        keep = -(-num_tokens // self.block_size)
+        released = 0
+        while len(request.block_ids) > keep:
+            self._release(request.block_ids.pop())
+            released += 1
+        return released
+
     def uncache_block(self, block_id: int) -> None:
         """Drop a block's cache entry (used by offload tier on invalidation)."""
         h = self._hash_of.pop(block_id, None)

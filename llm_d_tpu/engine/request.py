@@ -93,6 +93,21 @@ class Request:
     resume_offset: int = 0
     resume_restored_tokens: int = 0
 
+    # --- speculative decode (MTP draft-and-verify) ---
+    # Drafts the drafter head proposed for THIS request's next decode
+    # step, produced on device by the previous spec step and fetched in
+    # its one batched sync.  ``spec_drafts_at`` tags the ``num_tokens``
+    # they were drafted from: any token appended outside the spec path
+    # (prefill completion, fallback rounds, resume) makes them stale and
+    # they are silently dropped.  The adaptive per-request draft depth
+    # lives in the predictor's acceptance tracker, read fresh each
+    # schedule pass; ``spec_drafted``/``spec_accepted`` accumulate
+    # lifetime draft/accept counts for metrics and the usage surface.
+    spec_drafts: List[int] = dataclasses.field(default_factory=list)
+    spec_drafts_at: int = -1
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+
     @property
     def slo_tier(self) -> int:
         """Criticality as a priority tier (critical=-1 < standard=0 <

@@ -23,7 +23,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from llm_d_tpu.engine.kv_cache import KVCacheManager
 from llm_d_tpu.engine.request import Request, RequestState
@@ -34,6 +34,11 @@ class ScheduledRequest:
     request: Request
     num_new_tokens: int           # tokens computed this step
     is_first_schedule: bool = False
+    # Speculative decode: draft tokens scheduled ON TOP of num_new_tokens
+    # for this decode entry (KV blocks already allocated to cover them;
+    # the engine's draft+verify program appends up to this many extra
+    # tokens and rolls the rejected tail's blocks back the same step).
+    num_draft_tokens: int = 0
 
 
 @dataclasses.dataclass
@@ -67,6 +72,14 @@ class Scheduler:
         # remote pull). While any exist, a stalled sole-running request waits
         # for their asynchronous release instead of being aborted.
         self.external_pinned_blocks = lambda: 0
+        # Speculative decode (set by the engine when spec decode is on):
+        # callable(Request) -> draft tokens to schedule for this decode
+        # entry.  Draft tokens are budgeted like real tokens and their KV
+        # blocks allocated up front, but they are strictly opportunistic —
+        # the allocation shrinks to the free pool (never preempts: evicting
+        # real work for speculative capacity would be a net loss) and the
+        # engine rolls the rejected tail back after verification.
+        self.spec_lookahead: Optional[Callable[[Request], int]] = None
 
     # ---------- queue ops ----------
 
@@ -210,8 +223,21 @@ class Scheduler:
                     req.state = RequestState.FINISHED_ABORTED
                     preempted.append(req)
                 continue
-            budget -= n
-            scheduled.append(ScheduledRequest(req, n))
+            spec_n = 0
+            if (self.spec_lookahead is not None and n == 1
+                    and req.num_computed_tokens == req.num_tokens - 1):
+                # Decode entry under spec decode: schedule up to K draft
+                # tokens on top of the mandatory one.  Drafts pay token
+                # budget like real compute and shrink to the free block
+                # pool — speculation never preempts or blocks real work.
+                spec_n = min(max(0, int(self.spec_lookahead(req))),
+                             budget - n)
+                while spec_n > 0 and self.kv.allocate(
+                        req, req.num_computed_tokens + n + spec_n) is None:
+                    spec_n -= 1
+            budget -= n + spec_n
+            scheduled.append(ScheduledRequest(
+                req, n, num_draft_tokens=spec_n))
             scheduled_ids.add(req.request_id)
 
         # 2. Waiting requests, FIFO within (criticality tier, priority)

@@ -179,6 +179,68 @@ def compute_logits(params: Params, hidden: jax.Array, config: ModelConfig) -> ja
     return jnp.dot(hidden, head, preferred_element_type=jnp.float32)
 
 
+def init_draft_params(config: ModelConfig, key: jax.Array) -> Params:
+    """MTP-style drafter head (DeepSeek-V3 multi-token prediction shape,
+    scaled to one module): combine the last hidden state with the
+    embedding of the token just sampled through a ``[2D, D]`` projection
+    plus one SwiGLU MLP, share the target's embedding / lm_head for the
+    draft logits, and reuse the SAME module at every draft depth.  Kept
+    OUTSIDE the target param tree (separate pytree in the engine) so
+    quantization, EPLB, PD weight paths and HF loading never see it."""
+    c = config
+    dt = c.jax_dtype
+    D, I = c.hidden_size, c.intermediate_size
+    k = iter(jax.random.split(key, 4))
+
+    def w(shape, kk):
+        return (jax.random.normal(kk, shape, jnp.float32)
+                * (shape[0] ** -0.5)).astype(dt)
+
+    return {
+        "h_norm": jnp.ones((D,), dt),
+        "e_norm": jnp.ones((D,), dt),
+        "proj": w((2 * D, D), next(k)),
+        "mlp_norm": jnp.ones((D,), dt),
+        "gate_proj": w((D, I), next(k)),
+        "up_proj": w((D, I), next(k)),
+        "down_proj": w((I, D), next(k)),
+    }
+
+
+def draft_propose(params: Params, draft_params: Params, hidden: jax.Array,
+                  last_ids: jax.Array, K: int,
+                  config: ModelConfig) -> jax.Array:
+    """Greedy MTP rollout: propose ``K`` draft ids from the last hidden
+    state + the just-sampled token.
+
+    ``hidden`` [S, D] is the target trunk's output at the position that
+    sampled ``last_ids`` [S] — each depth folds the previous draft's
+    embedding back in (h, t) -> h' -> shared-head logits -> argmax.
+    Drafts are greedy regardless of the request's sampling params: the
+    verifier only ever compares them against the target's own samples,
+    so draft sampling noise would cost acceptance and buy nothing."""
+    c = config
+    dp = draft_params
+
+    def one(carry, _):
+        h, tok = carry
+        e = params["embed"][tok].astype(h.dtype)
+        x = jnp.concatenate(
+            [L.rms_norm(h, dp["h_norm"], c.rms_norm_eps),
+             L.rms_norm(e, dp["e_norm"], c.rms_norm_eps)], axis=-1)
+        h2 = jnp.dot(x, dp["proj"])
+        h2 = h2 + L.swiglu_mlp(
+            L.rms_norm(h2, dp["mlp_norm"], c.rms_norm_eps),
+            dp["gate_proj"], dp["up_proj"], dp["down_proj"])
+        nxt = jnp.argmax(compute_logits(params, h2, c),
+                         axis=-1).astype(jnp.int32)
+        return (h2, nxt), nxt
+
+    (_, _), ids = jax.lax.scan(one, (hidden, last_ids.astype(jnp.int32)),
+                               None, length=K)
+    return jnp.swapaxes(ids, 0, 1)                   # [K, S] -> [S, K]
+
+
 def sharding_rules(config: ModelConfig):
     """(path-regex, PartitionSpec) table for TP over the mesh's ``tp`` axis.
 

@@ -20,8 +20,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from llm_d_tpu.models.config import ModelConfig
-from llm_d_tpu.models.llama import (
-    attention_block, compute_logits)   # noqa: F401  (compute_logits re-export)
+from llm_d_tpu.models.llama import (  # noqa: F401  (re-exports: the MoE
+    # model shares the dense family's logits head and MTP drafter — the
+    # drafter reads only embed/lm_head from the target params, which both
+    # families carry identically)
+    attention_block, compute_logits, draft_propose, init_draft_params)
 from llm_d_tpu.ops import layers as L
 from llm_d_tpu.ops import moe as moe_ops
 from llm_d_tpu.parallel.mesh import AXIS_EP

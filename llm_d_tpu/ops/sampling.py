@@ -95,6 +95,63 @@ def sample(
     return jnp.where(temperature <= 0.0, greedy_ids, sampled_ids)
 
 
+def spec_verify(
+    logits: jax.Array,        # [S*(K+1), V] f32 (position-major per seq)
+    draft_tokens: jax.Array,  # [S, K] i32 drafted ids fed at q slots 1..K
+    spec_n: jax.Array,        # [S] i32 live drafts per seq (0 = plain decode)
+    temperature: jax.Array,   # [S] f32
+    top_k: jax.Array,         # [S] i32
+    top_p: jax.Array,         # [S] f32
+    key: jax.Array,
+    seeds: jax.Array,         # [S] i32, -1 = unseeded
+    gen0: jax.Array,          # [S] i32 output tokens emitted before this step
+    fixed_accept: Optional[float] = None,   # bench: seeded acceptance rate
+    step: Optional[jax.Array] = None,       # scalar i32 (fixed_accept key)
+) -> tuple:                   # (ids [S, K+1], accepted [S] in 0..K)
+    """On-device draft verification + bonus-token sampling.
+
+    Every query position samples the TARGET model's token with the same
+    per-position randomness the non-spec engine uses — seeded rows via
+    ``fold_in(fold_in(zero_key, seed), gen0 + q)`` (the vLLM seed
+    contract, so position q's draw is identical whether it was reached
+    speculatively or one step at a time), greedy rows via argmax.  A
+    draft is accepted while it EQUALS the target's own sample at that
+    position; the first mismatch position's target sample is the
+    correction token, and a fully-accepted row's last position yields
+    the bonus token — so the emitted prefix ``ids[:, :accepted+1]`` is
+    byte-identical to non-spec decode for greedy and seeded sampling,
+    whatever the drafter proposed.  Drafter quality moves throughput
+    only, never output.
+
+    ``fixed_accept`` (bench/diagnostics only, like stub components):
+    replace the equality check with a SEEDED per-draft coin at this rate
+    keyed on (step, row) — deterministic accepted-length schedules for
+    the accepted-tok/s bench metric.  Changes model output (accepted
+    drafts are emitted verbatim); never used on the serving path.
+    """
+    S, K = draft_tokens.shape
+    Q = K + 1
+
+    def rep(x):
+        return jnp.repeat(x, Q)
+
+    gen_idx = (gen0[:, None] + jnp.arange(Q, dtype=jnp.int32)[None, :]
+               ).reshape(-1)
+    ids = sample(logits, rep(temperature), rep(top_k), rep(top_p), key,
+                 seeds=rep(seeds), gen_idx=gen_idx).reshape(S, Q)
+    if fixed_accept is not None:
+        fk = jax.random.fold_in(
+            jax.random.PRNGKey(0x5BEC),
+            step if step is not None else jnp.int32(0))
+        match = jax.random.uniform(fk, (S, K)) < fixed_accept
+    else:
+        match = draft_tokens == ids[:, :K]
+    live = jnp.arange(K, dtype=jnp.int32)[None, :] < spec_n[:, None]
+    accepted = jnp.cumprod((match & live).astype(jnp.int32),
+                           axis=1).sum(axis=1)
+    return ids, accepted
+
+
 def compute_logprobs(logits: jax.Array, token_ids: jax.Array) -> jax.Array:
     """Log-probability of the chosen tokens. logits [S, V], ids [S]."""
     logp = jax.nn.log_softmax(logits, axis=-1)

@@ -84,6 +84,54 @@ class LatencyModel:
         return m
 
 
+class SpecAcceptanceTracker:
+    """Per-request draft-acceptance bookkeeping feeding an adaptive K.
+
+    The speculative-decode engine reports (drafted, accepted) per request
+    per step; this keeps an EMA acceptance rate per request and answers
+    ``suggest_k`` — the draft depth worth paying for next step.  Policy
+    mirrors the latency models' "conditional mean + spread" spirit in the
+    cheapest form that works online: below ``low`` the drafter is wasting
+    verify FLOPs on this request's distribution, so back off to K=1 (one
+    draft keeps measuring acceptance so recovery is possible); at or
+    above it run the full depth.  Untracked requests start at full depth
+    (optimistic: the first observations correct quickly at EMA 0.4).
+    """
+
+    def __init__(self, k_max: int, low: float = 0.35,
+                 alpha: float = 0.4, cap: int = 4096) -> None:
+        self.k_max = max(1, int(k_max))
+        self.low = low
+        self.alpha = alpha
+        self.cap = cap                       # bounded per-request table
+        self._rate: Dict[str, float] = {}
+
+    def observe(self, request_id: str, drafted: int, accepted: int) -> None:
+        if drafted <= 0:
+            return
+        r = accepted / drafted
+        prev = self._rate.get(request_id)
+        if prev is None and len(self._rate) >= self.cap:
+            # Bounded table: drop an arbitrary stale entry rather than
+            # growing without limit under request-id churn.
+            self._rate.pop(next(iter(self._rate)))
+        self._rate[request_id] = (r if prev is None
+                                  else (1 - self.alpha) * prev
+                                  + self.alpha * r)
+
+    def rate(self, request_id: str) -> Optional[float]:
+        return self._rate.get(request_id)
+
+    def suggest_k(self, request_id: str) -> int:
+        r = self._rate.get(request_id)
+        if r is None or r >= self.low:
+            return self.k_max
+        return 1                             # backoff: keep measuring
+
+    def forget(self, request_id: str) -> None:
+        self._rate.pop(request_id, None)
+
+
 class TrainingStore:
     """Capped sample buckets + retrain policy for both targets."""
 

@@ -1160,7 +1160,8 @@ def engine_config_from_args(args) -> EngineConfig:
         dbo_decode_token_threshold=args.dbo_decode_token_threshold,
         dbo_prefill_token_threshold=args.dbo_prefill_token_threshold,
         enable_eplb=args.enable_eplb,
-        eplb_config=json.loads(args.eplb_config) if args.eplb_config else None)
+        eplb_config=json.loads(args.eplb_config) if args.eplb_config else None,
+        spec_k=args.spec_k)
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -1296,6 +1297,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--eplb-config", default=None,
         help='JSON eplb config, e.g. \'{"window_size":1000,'
              '"step_interval":3000,"num_redundant_experts":32}\'')
+    p.add_argument(
+        "--spec-k", type=int, default=None,
+        help="speculative decoding (MTP draft-and-verify): draft tokens "
+             "per decode step; the engine verifies all K drafts in one "
+             "fused forward and emits 1..K+1 tokens per step, "
+             "byte-identical to non-spec decode for greedy and seeded "
+             "sampling, with per-request adaptive backoff to K=1 on low "
+             "acceptance.  Default: LLMD_SPEC_K (0 = off); "
+             "LLMD_SPEC_DECODE=off is the kill switch")
     p.add_argument(
         "--kv-transfer-config", default=None,
         help="JSON KV-connector config for PD disaggregation, e.g. "

@@ -23,6 +23,7 @@ import argparse
 import asyncio
 import json
 import logging
+import random
 import time
 import uuid as uuid_mod
 from typing import Any, Dict, List, Optional
@@ -31,6 +32,7 @@ from aiohttp import web
 
 from llm_d_tpu.server import stream_resume
 from llm_d_tpu.utils import tracing
+from llm_d_tpu.utils.config import env_choice, env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 from llm_d_tpu.utils.hashing import hash_token_blocks
 from llm_d_tpu.utils.lifecycle import (
@@ -66,6 +68,8 @@ class SimConfig:
         block_size: int = 64,
         startup_delay_s: float = 0.0,
         seed: int = 0,
+        spec_k: Optional[int] = None,
+        spec_acceptance: float = 0.7,
     ) -> None:
         self.model = model
         self.ttft_ms = ttft_ms
@@ -75,6 +79,11 @@ class SimConfig:
         self.block_size = block_size
         self.startup_delay_s = startup_delay_s
         self.seed = seed
+        # Speculative-decode mirror: draft depth K (None resolves the
+        # engine's env knobs — LLMD_SPEC_DECODE / LLMD_SPEC_K) and the
+        # seeded per-draft acceptance rate of the sim's acceptance model.
+        self.spec_k = spec_k
+        self.spec_acceptance = spec_acceptance
 
 
 class InferenceSimulator:
@@ -100,6 +109,21 @@ class InferenceSimulator:
         # replica via match=) — every in-flight stream breaks abruptly
         # and new work is refused, exactly like a crashed engine core.
         self.dead = False
+        # Speculative-decode mirror (round 12): with spec_k > 0 tokens
+        # are emitted in variable-size CHUNKS (1..K+1 per engine step,
+        # from a seeded acceptance model) on multi-token SSE frames, and
+        # one TPOT is charged per STEP instead of per token — the same
+        # shapes and accepted-throughput effect the real draft+verify
+        # engine produces, minus the accelerator.  config.spec_k = None
+        # resolves the engine's env knobs so a chaos fleet flips modes
+        # with one environment.
+        spec_k = config.spec_k
+        if spec_k is None:
+            spec_k = (env_int("LLMD_SPEC_K", 0)
+                      if env_choice("LLMD_SPEC_DECODE", "auto",
+                                    ("auto", "off")) != "off" else 0)
+        self.spec_k = max(0, int(spec_k))
+        self.spec_acceptance = config.spec_acceptance
         self._running = 0
         self._waiting = 0
         self._blocks_used = 0          # simulated KV blocks held
@@ -158,6 +182,33 @@ class InferenceSimulator:
                 self.kv_event_sink("BlockRemoved", [oldest])
         if stored and self.kv_event_sink:
             self.kv_event_sink("BlockStored", stored)
+
+    def spec_plan(self, prompt_ids: List[int], start: int,
+                  max_tokens: int) -> List[int]:
+        """Seeded acceptance model: per-step emitted-chunk sizes for a
+        spec-decode stream, deterministic per (sim seed, prompt, resume
+        offset).  Each step drafts K tokens and accepts a geometric
+        prefix at ``spec_acceptance`` per draft, emitting 1 + accepted
+        tokens — the real verifier's shape.  Deterministic per offset so
+        a PR 9 resume's continuation chunks splice at exact journal
+        offsets; empty when spec is off (one token per frame, today's
+        stream byte for byte)."""
+        K = self.spec_k
+        if K <= 0:
+            return []
+        rng = random.Random(self.config.seed * 1000003
+                            + len(prompt_ids) * 8191
+                            + (sum(prompt_ids) & 0xFFFF) * 127 + start)
+        plan: List[int] = []
+        i = start
+        while i < max_tokens:
+            a = 0
+            while a < K and rng.random() < self.spec_acceptance:
+                a += 1
+            c = min(1 + a, max_tokens - i)
+            plan.append(c)
+            i += c
+        return plan
 
     # ---------- request lifecycle ----------
 
@@ -296,6 +347,18 @@ class InferenceSimulator:
             reason = "length"
             emitted = 0
             d0 = time.time()
+            # Spec mirror: the plan's chunk sizes are the per-step
+            # accepted token counts; one TPOT per STEP (a draft+verify
+            # step costs one forward whatever it emits) and the spec
+            # counters advance per step.  The SSE writer consumes the
+            # same plan to build multi-token frames.
+            plan = self.spec_plan(prompt_ids, start, ticket["max_tokens"])
+            ticket["spec_plan"] = plan
+            step_starts: Dict[int, int] = {}
+            pos = start
+            for csize in plan:
+                step_starts[pos] = csize
+                pos += csize
             for i in range(start, ticket["max_tokens"]):
                 if self.dead:
                     raise RuntimeError("engine dead")
@@ -308,7 +371,11 @@ class InferenceSimulator:
                     if span is not None:
                         span.add_event("fault.engine.step", token=i)
                     raise
-                if emitted > 0:
+                if i in step_starts and self.spec_k > 0:
+                    self.metrics.spec_draft_tokens.inc(self.spec_k)
+                    self.metrics.spec_accepted_tokens.inc(
+                        step_starts[i] - 1)
+                if emitted > 0 and (not step_starts or i in step_starts):
                     await asyncio.sleep(c.tpot_ms / 1e3)
                     self.metrics.inter_token_latency.observe(c.tpot_ms / 1e3)
                 if deadline_epoch is not None \
@@ -520,29 +587,59 @@ class SimServer:
                 # can't fire, so release here or the slot leaks.
                 self.sim.release_ticket(ticket)
                 raise
+            # Frame assembly: with spec decode on, tokens group into the
+            # plan's per-step chunks — ONE SSE frame per engine step
+            # carrying the whole accepted run in its llmd meta (the
+            # multi-token journal/offset shape the relays and PR 9
+            # resumes must handle); spec off = one token per frame,
+            # today's stream byte for byte.
             first = True
-            async for i, text in self.sim.stream_tokens(ticket):
-                finished = i == max_tokens - 1
+            buf_start: Optional[int] = None
+            buf_words: List[str] = []
+            pi = 0
+
+            async def flush(finished: bool) -> None:
+                nonlocal first, buf_start, buf_words
+                if buf_start is None:
+                    return
                 choice: Dict[str, Any] = {
                     "index": 0,
                     "finish_reason": "length" if finished else None}
+                text = "".join(buf_words)
                 if chat:
                     choice["delta"] = {"content": text}
                 else:
                     choice["text"] = text
                 src = ticket["resume_src"] if first and start else None
                 first = False
+                toks = [(len(prompt_ids) + j) % len(_LOREM)
+                        for j in range(buf_start,
+                                       buf_start + len(buf_words))]
                 chunk = {"id": rid, "created": created, "model": model,
                          "object": ("chat.completion.chunk" if chat
                                     else "text_completion"),
                          "choices": [choice],
                          stream_resume.CHUNK_META_KEY:
                          stream_resume.chunk_meta(
-                             i, [(len(prompt_ids) + i) % len(_LOREM)],
-                             src=src,
+                             buf_start, toks, src=src,
                              restored_tokens=ticket["resume_restored"])}
+                buf_start, buf_words = None, []
                 await resp.write(b"data: " + json.dumps(chunk).encode()
                                  + b"\n\n")
+
+            async for i, text in self.sim.stream_tokens(ticket):
+                if buf_start is None:
+                    buf_start = i
+                buf_words.append(text)
+                # The plan lands on the ticket at generator start (the
+                # async-for above primes it), so read it lazily here.
+                plan = ticket.get("spec_plan") or []
+                target = plan[pi] if pi < len(plan) else 1
+                finished = i == max_tokens - 1
+                if len(buf_words) >= target or finished:
+                    await flush(finished)
+                    pi += 1
+            await flush(False)      # deadline-truncated tail, if any
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
@@ -615,13 +712,22 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--block-size", type=int, default=64)
     p.add_argument("--startup-delay", type=float, default=0.0,
                    help="seconds before /v1/models turns ready")
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="speculative-decode mirror: draft depth K "
+                        "(tokens stream in 1..K+1 chunks per step from "
+                        "a seeded acceptance model, one TPOT per step); "
+                        "default resolves LLMD_SPEC_DECODE/LLMD_SPEC_K")
+    p.add_argument("--spec-acceptance", type=float, default=0.7,
+                   help="seeded per-draft acceptance rate of the spec "
+                        "mirror's acceptance model")
     args = p.parse_args(argv)
 
     cfg = SimConfig(
         model=args.model, ttft_ms=args.time_to_first_token,
         tpot_ms=args.inter_token_latency, max_num_seqs=args.max_num_seqs,
         num_blocks=args.num_blocks, block_size=args.block_size,
-        startup_delay_s=args.startup_delay)
+        startup_delay_s=args.startup_delay, spec_k=args.spec_k,
+        spec_acceptance=args.spec_acceptance)
     logging.basicConfig(level=logging.INFO)
     web.run_app(build_sim_server(cfg).build_app(),
                 host=args.host, port=args.port)

@@ -49,6 +49,13 @@ REQUEST_RECOVERY_METRIC = "llmd_tpu:request_recovery_seconds"
 # (EngineMetrics: prefill/transfer/decode phases) — registries are
 # per-component.
 REQUEST_PHASE_METRIC = "llmd_tpu:request_phase_seconds"
+# Speculative decode (MTP draft-and-verify): drafts proposed vs drafts
+# accepted by target-model verification.  accepted/drafted is the live
+# acceptance rate the adaptive-K policy acts on; accepted counts DRAFT
+# tokens only (the per-step correction/bonus token is ordinary decode
+# output and lands in vllm:generation_tokens_total like any other).
+SPEC_DRAFT_METRIC = "llmd_tpu:spec_draft_tokens_total"
+SPEC_ACCEPTED_METRIC = "llmd_tpu:spec_accepted_tokens_total"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -179,6 +186,14 @@ class EngineMetrics:
             "phase and criticality class.",
             ["model_name", "phase", "criticality"], buckets=_TIME_BUCKETS,
             registry=self.registry)
+        # Speculative decode (see the SPEC_* constants above).
+        self.spec_draft_tokens = counter(
+            SPEC_DRAFT_METRIC,
+            "Draft tokens proposed by the MTP drafter and verified by "
+            "the target model.")
+        self.spec_accepted_tokens = counter(
+            SPEC_ACCEPTED_METRIC,
+            "Draft tokens the target model accepted (emitted verbatim).")
 
     def observe_phase(self, phase: str, criticality: str,
                       seconds: float) -> None:

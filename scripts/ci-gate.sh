@@ -50,10 +50,18 @@ python -m pytest tests/test_mla_quant.py -q
 # alignment, per-collective accuracy bounds on real routed traces,
 # env-knob fallback): a silent wire-numerics break must not merge.
 python -m pytest tests/test_collective_quant.py -q
+# Speculative-decode contract fail-fast (round 12: MTP draft-and-verify
+# — greedy + seeded byte-identical parity vs non-spec decode, rejection
+# rollback leaving the paged-KV pool leak-free and the prefix cache
+# accepted-content-only, adaptive-K backoff, the LLMD_SPEC_DECODE=off
+# kill switch, chaos resume during spec decode with exact multi-token
+# journal offsets, and the no-new-host-sync JIT meta-gate).
+python -m pytest tests/test_spec_decode.py -q
 python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_mla_quant.py \
     --ignore=tests/test_collective_quant.py \
     --ignore=tests/test_stream_recovery.py \
     --ignore=tests/test_llmd_race.py \
+    --ignore=tests/test_spec_decode.py \
     --ignore=tests/test_tracing.py

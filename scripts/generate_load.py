@@ -21,7 +21,9 @@ Examples:
   python scripts/generate_load.py --url http://gw:8000 --stream --qps 10
       # SSE streams with the continuity oracle: stream_breaks and
       # continuity_errors in the summary must be 0 under mid-stream
-      # recovery chaos (see docs/resilience.md)
+      # recovery chaos (see docs/resilience.md).  The oracle accepts
+      # multi-token chunks (spec-decode servers emit one frame per
+      # engine step) and the summary reports accepted_tokens_per_step
   python scripts/generate_load.py --url http://gw:8000 --qps 10 \
       --trace-export /tmp/run.jsonl
       # post-run: scrape /debug/traces from the gateway (and any
@@ -201,6 +203,18 @@ async def one_request(session, args, rng, stats) -> None:
                         stats["continuity_errors"] = \
                             stats.get("continuity_errors", 0) + len(problems)
                         print(f"continuity: {problems}")
+                    # Accepted-tokens-per-step: a spec-decode server
+                    # emits each engine step's accepted run as ONE
+                    # multi-token frame, so tokens-per-token-chunk IS
+                    # the accepted throughput multiplier (1.0 = no
+                    # speculation).  The oracle above is chunk-size
+                    # agnostic either way.
+                    sizes = [len(m.get("tok") or []) for m in metas
+                             if m.get("tok")]
+                    stats["token_chunks"] = \
+                        stats.get("token_chunks", 0) + len(sizes)
+                    stats["chunk_tokens"] = \
+                        stats.get("chunk_tokens", 0) + sum(sizes)
         else:
             async with session.post(f"{args.url}/v1/completions", json=body,
                                     headers=headers, **kw) as resp:
@@ -250,6 +264,8 @@ async def run(args) -> None:
         }
     breaks = stats.pop("stream_breaks", 0)
     cont_errors = stats.pop("continuity_errors", 0)
+    n_chunks = stats.pop("token_chunks", 0)
+    n_chunk_tokens = stats.pop("chunk_tokens", 0)
     summary = {
         "requests": sum(v for v in stats.values()),
         "status_counts": stats,
@@ -261,6 +277,10 @@ async def run(args) -> None:
     if args.stream:
         summary["stream_breaks"] = breaks
         summary["continuity_errors"] = cont_errors
+        # 1.0 = one token per SSE frame (no speculation); a spec-decode
+        # upstream pushes this toward its accepted tokens per step.
+        summary["accepted_tokens_per_step"] = round(
+            n_chunk_tokens / n_chunks, 3) if n_chunks else None
     if args.trace_export:
         summary["trace"] = await export_traces(args)
     print(json.dumps(summary))

@@ -416,6 +416,98 @@ def run_a2a(args) -> dict:
     }
 
 
+# --- speculative decode: draft-depth (K) sweep through the real engine ---
+# Accepted tok/s vs K at a fixed seeded acceptance rate — how the
+# LLMD_SPEC_K default gets re-derived on a real chip (bench.py gates the
+# single bs256 point; this sweeps the depth).  One engine per K: spec_k
+# is baked into the fused draft+verify program's shapes.  --interpret
+# (CPU CI) runs the tiny model so tier-1 exercises the whole glue —
+# scheduler draft allocation, the spec program, rejection rollback —
+# with timings flagged invalid.
+
+
+def run_spec(args) -> dict:
+    from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+    from llm_d_tpu.engine.request import Request
+    from llm_d_tpu.ops.sampling import SamplingParams
+
+    if args.interpret:
+        model, bs, prompt_len, decode_steps = "tiny", 4, 16, 12
+        quant = kvd = None
+        sweep = [1, 2, 4]
+        vocab = 500
+    else:
+        model, bs, prompt_len, decode_steps = ("deepseek-v3-bench", 256,
+                                               128, 64)
+        quant, kvd = "int8", "int8"
+        sweep = [1, 2, 4, 8]
+        vocab = 32000
+    if args.k_sweep:
+        sweep = [int(k) for k in args.k_sweep.split(",") if k]
+    accept = args.spec_accept
+    block_size = 32 if args.interpret else 64
+
+    def make_reqs(tag, offset):
+        return [
+            Request(
+                request_id=f"{tag}-{i}",
+                prompt_token_ids=[(7 * i + 13 * j + offset) % vocab + 1
+                                  for j in range(prompt_len)],
+                sampling=SamplingParams(temperature=0.0,
+                                        max_tokens=decode_steps + 1,
+                                        ignore_eos=True))
+            for i in range(bs)]
+
+    def run_workload(engine, reqs):
+        for r in reqs:
+            engine.add_request(r)
+        while any(r.num_computed_tokens < r.num_prompt_tokens
+                  for r in reqs):
+            engine.step()
+        before = sum(len(r.output_token_ids) for r in reqs)
+        t0 = time.perf_counter()
+        while engine.has_work():
+            engine.step()
+        dt = time.perf_counter() - t0
+        return sum(len(r.output_token_ids) for r in reqs) - before, dt
+
+    points = []
+    for K in sweep:
+        blocks_per_seq = -(-(prompt_len + decode_steps + K + 2)
+                           // block_size)
+        engine = EngineCore(EngineConfig(
+            model=model, block_size=block_size,
+            num_blocks=bs * blocks_per_seq + block_size,
+            max_num_seqs=bs, max_num_batched_tokens=8192,
+            enable_prefix_caching=False, quantization=quant,
+            kv_cache_dtype=kvd, spec_k=K, spec_fixed_accept=accept))
+        assert engine.spec_k == K, "spec decode failed to arm"
+        run_workload(engine, make_reqs(f"warm{K}", 50000))  # compile pass
+        reqs = make_reqs(f"spec{K}", 1000)
+        steps0 = engine._step_count
+        tokens, dt = run_workload(engine, reqs)
+        n_steps = engine._step_count - steps0
+        drafted = sum(r.spec_drafted for r in reqs)
+        accepted = sum(r.spec_accepted for r in reqs)
+        points.append({
+            "K": K,
+            "accepted_tok_s": round(tokens / dt, 1),
+            "ms_per_step": round(1e3 * dt / max(1, n_steps), 3),
+            "acceptance_pct": round(100 * accepted / drafted, 1)
+            if drafted else None,
+        })
+    best = max(points, key=lambda p: p["accepted_tok_s"])
+    return {
+        "mode": "spec",
+        "backend": jax.default_backend(),
+        "interpret": args.interpret,
+        "timings_valid": not args.interpret,
+        "model": model, "bs": bs, "fixed_accept": accept,
+        "points": points,
+        "recommended_k": best["K"],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interpret", action="store_true",
@@ -437,6 +529,19 @@ def main(argv=None) -> int:
                          "instead of the MoE kernel family; needs a "
                          "multi-device mesh (--interpret forces 8 "
                          "virtual CPU devices)")
+    ap.add_argument("--spec", action="store_true",
+                    help="run the speculative-decode draft-depth (K) "
+                         "sweep through the real draft+verify engine at "
+                         "a fixed seeded acceptance (--spec-accept) "
+                         "instead of the MoE kernel family; --interpret "
+                         "runs the tiny model on CPU (glue smoke)")
+    ap.add_argument("--k-sweep", type=str, default=None,
+                    help="spec mode: comma-separated draft depths "
+                         "(default 1,2,4,8 on chip; 1,2,4 interpreted)")
+    ap.add_argument("--spec-accept", type=float, default=0.7,
+                    help="spec mode: seeded per-draft acceptance rate "
+                         "(bench.py SPEC_BENCH_ACCEPT quotes the gated "
+                         "metric at the same rate)")
     ap.add_argument("--ctx-sweep", type=str, default=None,
                     help="paged/mla mode: comma-separated context lengths "
                          "(default: 256..4096 on chip, 64,128 interpreted)")
@@ -456,9 +561,10 @@ def main(argv=None) -> int:
                     help="also write the JSON document to this path")
     args = ap.parse_args(argv)
 
-    if args.paged or args.mla or args.a2a:
+    if args.paged or args.mla or args.a2a or args.spec:
         doc = (run_paged(args) if args.paged
-               else run_mla(args) if args.mla else run_a2a(args))
+               else run_mla(args) if args.mla
+               else run_spec(args) if args.spec else run_a2a(args))
         text = json.dumps(doc)
         print(text)
         if args.out:

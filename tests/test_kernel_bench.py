@@ -123,6 +123,27 @@ def test_kernel_bench_a2a_sweep_interpret(tmp_path, capsys):
         assert b["int8-dispatch"] < b["bf16"] < b["f32-combine"]
 
 
+def test_kernel_bench_spec_sweep_interpret(tmp_path, capsys):
+    """--spec: the draft-depth (K) sweep runs the REAL draft-and-verify
+    engine (scheduler draft allocation, fused spec program, rejection
+    rollback) on CPU at a fixed seeded acceptance — one engine per K,
+    accepted-tok/s + measured acceptance per point, a recommended K."""
+    mod = _kernel_bench()
+    out = tmp_path / "spec.json"
+    rc = mod.main(["--spec", "--interpret", "--k-sweep", "1,2",
+                   "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc == json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["mode"] == "spec" and doc["timings_valid"] is False
+    assert [p["K"] for p in doc["points"]] == [1, 2]
+    for p in doc["points"]:
+        assert p["accepted_tok_s"] > 0 and p["ms_per_step"] > 0
+        # The seeded coin at 0.7/draft must actually accept drafts.
+        assert p["acceptance_pct"] and p["acceptance_pct"] > 20
+    assert doc["recommended_k"] in (1, 2)
+
+
 def test_kernel_bench_respects_path_caps(tmp_path):
     """--dense-max-t / --routed-max-t null out the capped paths (the
     shapes a real chip cannot run) and the recommendation still derives
