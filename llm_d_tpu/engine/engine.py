@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -37,7 +38,7 @@ from llm_d_tpu.ops.quant import (
     KV_CACHE_DTYPES, KV_SCALE_GRANULARITIES, MLA_LATENT_DTYPES,
     kv_scale_width)
 from llm_d_tpu.utils import tracing
-from llm_d_tpu.utils.config import env_choice, env_int
+from llm_d_tpu.utils.config import env_choice, env_float, env_int
 from llm_d_tpu.utils.faultinject import get_injector
 from llm_d_tpu.utils.metrics import EngineMetrics
 
@@ -290,6 +291,26 @@ class EngineCore:
             max_num_seqs=config.max_num_seqs,
             max_num_batched_tokens=config.max_num_batched_tokens,
             max_model_len=c.max_model_len)
+        # Decode-priority chunk budgeting (round 15): the scheduler funds
+        # decode entries (plus spec lookahead) first and asks this engine
+        # for a per-chunk prefill token cap.  LLMD_PREFILL_CHUNK pins a
+        # fixed cap; "auto" (the default) sizes chunks from the online
+        # step-latency model against LLMD_STEP_TIME_TARGET_MS — with no
+        # target set the cap stays off and chunks are budget-bound only
+        # (the historical behavior, byte for byte).
+        from llm_d_tpu.predictor.model import StepTimeModel
+        raw_chunk = os.environ.get("LLMD_PREFILL_CHUNK", "auto")
+        self._prefill_chunk_fixed: Optional[int] = None
+        if raw_chunk != "auto":
+            try:
+                self._prefill_chunk_fixed = max(1, int(raw_chunk))
+            except ValueError:
+                logger.warning(
+                    "LLMD_PREFILL_CHUNK=%r is neither 'auto' nor an "
+                    "integer; using 'auto'", raw_chunk)
+        self._step_time_target_ms = env_float("LLMD_STEP_TIME_TARGET_MS", 0.0)
+        self.step_time_model = StepTimeModel()
+        self.scheduler.prefill_chunk_cap = self._prefill_chunk_cap
         self.metrics = metrics or EngineMetrics(c.name)
         # llmd-trace: engine phase spans (queue/prefill/decode + step
         # boundaries).  Everything recorded here is host-side clock
@@ -461,6 +482,7 @@ class EngineCore:
         self.draft_params = None
         self.spec_tracker = None
         self._spec_fn = None
+        self._fused_fns: Dict[Tuple[bool, bool], Any] = {}
         if spec_mode != "off" and spec_k > 0:
             # Composition gates: spec decode owns the multi-token decode
             # step, so the fused-multistep/async pipeline and the spec
@@ -485,7 +507,11 @@ class EngineCore:
                         c, jax.random.PRNGKey(config.seed + 1)),
                     NamedSharding(self.mesh, P()))
                 self.spec_tracker = SpecAcceptanceTracker(self.spec_k)
-                self._spec_fn = self._build_spec_fn(self.spec_k)
+                # The base fused mixed-round program; logprobs variants
+                # compile on first use (keyed by (want_logprobs,
+                # want_top) like the classic _step_fn/_step_fn_top pair).
+                self._spec_fn = self._build_fused_fn(self.spec_k)
+                self._fused_fns = {(False, False): self._spec_fn}
                 self.scheduler.spec_lookahead = self._spec_lookahead
                 logger.info("spec decode on: K=%d%s", self.spec_k,
                             f" (fixed acceptance "
@@ -501,6 +527,23 @@ class EngineCore:
             if config.num_scheduler_steps > 1 else None)
 
     # ---------- jitted step ----------
+
+    def _prefill_chunk_cap(self, decode_tokens: int) -> Optional[int]:
+        """Per-chunk prefill token cap for one schedule pass (the
+        scheduler's decode-priority callback; ``decode_tokens`` is the
+        decode + spec-lookahead load already funded).  Fixed
+        LLMD_PREFILL_CHUNK wins; otherwise the step-latency model picks
+        the largest chunk predicted to keep the step under the target
+        step time; no target -> None (budget-bound only)."""
+        if self._prefill_chunk_fixed is not None:
+            return self._prefill_chunk_fixed
+        if self._step_time_target_ms <= 0.0 \
+                or not self.step_time_model.trained:
+            return None
+        return self.step_time_model.chunk_for(
+            decode_tokens, self._step_time_target_ms,
+            lo=self.config.min_token_bucket,
+            hi=self.config.max_num_batched_tokens)
 
     def _moe_opts(self) -> Optional[Dict[str, Any]]:
         """MoE dispatch knobs, captured by every step program.  The model
@@ -936,30 +979,46 @@ class EngineCore:
         k = min(k, sp.max_tokens - len(req.output_token_ids) - 1)
         return max(0, k)
 
-    def _build_spec_fn(self, K: int):
-        """One fused draft+verify device program: a single target-model
-        forward over each sequence's K+1 query positions (last accepted
-        token + K drafts — the idle-FLOP spend: decode is HBM-bound, so
-        verifying K extra rows rides the same weight stream), on-device
-        accept/reject + bonus sampling (ops/sampling.spec_verify, seeded
-        rows via fold_in(seed, gen_idx) for byte-identical parity), and
-        the MTP drafter proposing the NEXT step's K drafts from the last
-        accepted position's hidden state.  Only the sampled ids, the
-        accepted counts and the next drafts travel host-ward — in the
-        step's one batched fetch, never a new sync."""
+    def _build_fused_fn(self, K: int, want_logprobs: bool = False,
+                        want_top: bool = False):
+        """ONE mixed-round device program: prefill-chunk rows, plain-decode
+        rows and K+1 draft-verify rows share a single forward (the ragged
+        chunked-prefill batch layout), so a prefill chunk joining a decode
+        round rides the SAME per-layer expert-weight stream the decode
+        already pays — the HBM weight traffic is amortized over both
+        populations (the MoE prefill-MFU lever), and spec decode stays ON
+        under continuous prefill traffic.
+
+        Per-row dispatch happens via the batch's fixed [S*(K+1)] verify-
+        stride ``sample_idx``: a decode row gathers its 1+nd computed
+        positions (tail replicated), so spec_verify accepts/rejects and
+        samples the bonus exactly as the pure-spec program did; a prefill
+        row replicates its chunk's LAST position into every slot, so
+        spec_n=0 makes verification degenerate to classic first-token
+        sampling at slot 0 (seeded rows: fold_in(seed, gen0=0) == the
+        classic path's fold_in(seed, gen_idx) — byte-identical parity),
+        and mid-prefill rows' slot-0 samples are simply discarded host-
+        side.  The drafter proposes next-step drafts for EVERY row from
+        its accepted position's hidden state — prefill-completing rows
+        therefore enter their first decode step already spec-armed.
+        ``want_logprobs``/``want_top`` add the classic sampling epilogue
+        for slot-0 logits only for the rows that asked (variants cached
+        like _step_fn/_step_fn_top).  Only ids, accepted counts, drafts
+        and the optional logprob arrays travel host-ward — in the step's
+        one batched fetch, never a new sync."""
         c = self.model_config
         block_size = self.config.block_size
         backend = self.config.attn_backend
         model, mesh = self.model, self.mesh
         moe_opts = self._moe_opts()
         fixed = self.config.spec_fixed_accept
-        Q = K + 1
+        Qv = K + 1
 
         @functools.partial(jax.jit, donate_argnums=(2,))
-        def spec_fn(params, draft_params, kv_cache, batch, rng):
+        def fused_fn(params, draft_params, kv_cache, batch, rng):
             hidden, kv_cache = model.forward(
                 params, kv_cache, batch, c, block_size, backend,
-                mesh=mesh, moe_opts=moe_opts)       # [S*Q, D]
+                mesh=mesh, moe_opts=moe_opts)       # [S*Qv, D]
             logits = model.compute_logits(params, hidden, c)
             ids, accepted = sampling_ops.spec_verify(
                 logits, batch["draft_tokens"], batch["spec_n"],
@@ -967,74 +1026,99 @@ class EngineCore:
                 rng, seeds=batch["seeds"], gen0=batch["gen0"],
                 fixed_accept=fixed, step=batch["spec_step"])
             S = accepted.shape[0]
-            h = hidden.reshape(S, Q, hidden.shape[-1])
+            h = hidden.reshape(S, Qv, hidden.shape[-1])
             h_a = jnp.take_along_axis(
                 h, accepted[:, None, None], axis=1)[:, 0]
             bonus = jnp.take_along_axis(ids, accepted[:, None], axis=1)[:, 0]
             drafts = model.draft_propose(
                 params, draft_params, h_a, bonus, K, c)
-            return ids, accepted, drafts, kv_cache
+            logprobs = top = None
+            if want_logprobs or want_top:
+                # Classic sampling epilogue for the rows that asked, on
+                # slot-0 logits only (logprobs requests schedule with
+                # spec_n=0, so slot 0 IS their sampled token's row).
+                logits0 = logits.reshape(S, Qv, logits.shape[-1])[:, 0]
+                if want_top:
+                    logprobs, top_ids, top_lps = \
+                        sampling_ops.compute_top_logprobs(logits0, ids[:, 0])
+                    top = (top_ids, top_lps)
+                else:
+                    logprobs = sampling_ops.compute_logprobs(
+                        logits0, ids[:, 0])
+            return ids, accepted, drafts, logprobs, top, kv_cache
 
-        return spec_fn
+        return fused_fn
 
-    def _build_spec_batch(self, scheduled) -> Dict[str, Any]:
-        """Host arrays for a spec round: every sequence gets a fixed K+1
-        query-slot stride (static shapes; S buckets like any batch).  A
-        sequence with fewer live drafts pads the tail of its stride
-        exactly like ordinary ragged-batch padding — trash-slot KV
-        writes, sentinel qtok rows — so the attention path sees a
-        standard chunked-prefill-shaped batch."""
+    def _build_fused_batch(self, scheduled) -> Dict[str, Any]:
+        """Host arrays for a fused mixed round: the ragged chunked-prefill
+        token layout (each row packs its real length — a prefill chunk's
+        n tokens, or a decode row's last-accepted token + nd drafts) plus
+        a FIXED [S*(K+1)] verify-stride ``sample_idx`` feeding spec_verify
+        whatever the row mix is, so one compiled program per (T, S, Q)
+        bucket covers pure-prefill, pure-decode and mixed rounds alike.
+
+        Per-row gather: decode row slots q map to token t0+min(q, nd)
+        (its computed positions, tail replicated — consumed slots q <= nd
+        always see real logits; slots past nd are masked by spec_n inside
+        spec_verify); prefill rows replicate the chunk's LAST token into
+        all slots (slot 0 is the classic first-token sample; the rest
+        feed nothing).  Padding rows gather token 0 and carry spec_n=0 /
+        temperature 0 — their samples are discarded host-side."""
         cfg = self.config
         K = self.spec_k
-        Q = K + 1
+        Qv = K + 1
         B = self.max_blocks_per_seq
         bs = cfg.block_size
         S = _next_bucket(len(scheduled),
                          min(cfg.min_seq_bucket, cfg.max_num_seqs),
                          cfg.max_num_seqs)
-        T = S * Q
-        arrs = dict(
-            token_ids=np.zeros(T, np.int32),
-            positions=np.zeros(T, np.int32),
-            token_seq_ids=np.zeros(T, np.int32),
-            token_qpos=np.zeros(T, np.int32),
-            slot_mapping=np.zeros(T, np.int32),   # local block 0 = trash
-            block_tables=np.zeros((S, B), np.int32),
-            seq_lens=np.zeros(S, np.int32),
-            # Verification needs logits at EVERY query position, so the
-            # sample gather covers all T rows (padding rows' logits are
-            # masked by spec_n / discarded host-side).
-            sample_idx=np.arange(T, dtype=np.int32),
-            qtok_idx=np.full((S, Q), T, np.int32),
-            temperature=np.zeros(S, np.float32),
-            top_k=np.zeros(S, np.int32),
-            top_p=np.ones(S, np.float32),
-            seeds=np.full(S, -1, np.int32),
-            gen0=np.zeros(S, np.int32),
-            draft_tokens=np.zeros((S, K), np.int32),
-            spec_n=np.zeros(S, np.int32),
-            spec_step=np.int32(self._step_count),
-        )
+        total = sum(sr.num_new_tokens + sr.num_draft_tokens
+                    for sr in scheduled)
+        # Drafts are budgeted like real tokens (scheduler charges n +
+        # spec_n), so total <= max_num_batched_tokens always holds.
+        T = _next_bucket(total, cfg.min_token_bucket,
+                         cfg.max_num_batched_tokens)
+        max_q = max((sr.num_new_tokens + sr.num_draft_tokens
+                     for sr in scheduled), default=1)
+        Q = 1 if max_q == 1 else _next_bucket(
+            max_q, cfg.min_token_bucket, cfg.max_num_batched_tokens)
+        arrs = self._empty_batch_np(T, S, Q, B)
+        del arrs["gen_idx"]     # spec_verify consumes gen0 + verify fields
+        arrs["sample_idx"] = np.zeros(S * Qv, np.int32)
+        arrs["gen0"] = np.zeros(S, np.int32)
+        arrs["draft_tokens"] = np.zeros((S, K), np.int32)
+        arrs["spec_n"] = np.zeros(S, np.int32)
+        arrs["spec_step"] = np.int32(self._step_count)
+        t = 0
         for s, sr in enumerate(scheduled):
-            req = sr.request
+            req, n = sr.request, sr.num_new_tokens
             nd = sr.num_draft_tokens
-            n = 1 + nd
+            n_row = n + nd
             p0 = req.num_computed_tokens
-            t0 = s * Q
-            arrs["token_ids"][t0] = req.all_token_ids[p0]
             if nd:
-                arrs["token_ids"][t0 + 1:t0 + n] = req.spec_drafts[:nd]
+                # Decode row: last accepted token + the live drafts.
+                arrs["token_ids"][t] = req.all_token_ids[p0]
+                arrs["token_ids"][t + 1:t + n_row] = req.spec_drafts[:nd]
                 arrs["draft_tokens"][s, :nd] = req.spec_drafts[:nd]
-            pos = np.arange(p0, p0 + n)
-            arrs["positions"][t0:t0 + n] = pos
-            arrs["token_seq_ids"][t0:t0 + n] = s
-            arrs["token_qpos"][t0:t0 + n] = np.arange(n)
+            else:
+                # Plain decode (n == 1) or prefill chunk: real tokens.
+                arrs["token_ids"][t:t + n_row] = \
+                    req.all_token_ids[p0:p0 + n]
+            pos = np.arange(p0, p0 + n_row)
+            arrs["positions"][t:t + n_row] = pos
+            arrs["token_seq_ids"][t:t + n_row] = s
+            arrs["token_qpos"][t:t + n_row] = np.arange(n_row)
             blocks = np.asarray(req.block_ids, np.int32)
-            arrs["slot_mapping"][t0:t0 + n] = \
+            arrs["slot_mapping"][t:t + n_row] = \
                 blocks[pos // bs] * bs + pos % bs
             arrs["block_tables"][s, :len(blocks)] = blocks
-            arrs["seq_lens"][s] = p0 + n
-            arrs["qtok_idx"][s, :n] = np.arange(t0, t0 + n)
+            arrs["seq_lens"][s] = p0 + n_row
+            arrs["qtok_idx"][s, :n_row] = np.arange(t, t + n_row)
+            if nd:
+                arrs["sample_idx"][s * Qv:(s + 1) * Qv] = \
+                    t + np.minimum(np.arange(Qv), nd)
+            else:
+                arrs["sample_idx"][s * Qv:(s + 1) * Qv] = t + n - 1
             sp = req.sampling
             arrs["temperature"][s] = sp.temperature
             arrs["top_k"][s] = sp.top_k
@@ -1043,37 +1127,154 @@ class EngineCore:
                 arrs["seeds"][s] = int(sp.seed) & 0x7FFFFFFF
             arrs["gen0"][s] = len(req.output_token_ids)
             arrs["spec_n"][s] = nd
+            t += n_row
         return arrs
 
-    def _run_spec(self, sched: SchedulerOutput) -> List[RequestOutput]:
-        """One draft-and-verify engine step over a pure-decode round.
+    def _run_fused(self, sched: SchedulerOutput) -> List[RequestOutput]:
+        """One fused mixed-round engine step (ANY row mix once spec decode
+        is armed: pure decode, pure prefill, or both in one program).
 
-        Emits 1..K+1 tokens per sequence (accepted drafts + the
-        correction/bonus token), rolls rejected tokens' tail blocks back
-        to the pool the same step (kv_cache.trim_request — the prefix
-        cache only ever hashes blocks full of ACCEPTED content, so PR 9
-        restores always land on a clean prefix), and stores the device-
-        proposed next drafts per request."""
+        Decode rows emit 1..K+1 tokens (accepted drafts + correction/
+        bonus) and roll rejected tokens' tail blocks back to the pool the
+        same step (kv_cache.trim_request — the prefix cache only ever
+        hashes blocks full of ACCEPTED content, so PR 9 restores always
+        land on a clean prefix).  Prefill rows advance their chunk with
+        the classic bookkeeping (TTFT / prompt / prefix counters, the
+        engine.prefill phase, PD-producer finish) and, when the chunk
+        completes the prompt, emit slot-0's sampled first token AND store
+        the device-proposed drafts — the request enters its first decode
+        step already spec-armed, so speculation never blinks across
+        prefill joins.  Logprobs rows take the classic sampling epilogue
+        (slot-0 logprob arrays from the fused program's variant) without
+        demoting any other row."""
         scheduled = sched.scheduled
         step_t0 = time.monotonic()
-        batch = jax.device_put(self._build_spec_batch(scheduled),
+        want_top = any((sr.request.sampling.logprobs or 0) > 0
+                       for sr in scheduled)
+        want_lp = any(sr.request.sampling.logprobs is not None
+                      for sr in scheduled)
+        fn = self._fused_fns.get((want_lp, want_top))
+        if fn is None:
+            fn = self._build_fused_fn(self.spec_k, want_logprobs=want_lp,
+                                      want_top=want_top)
+            self._fused_fns[(want_lp, want_top)] = fn
+        batch = jax.device_put(self._build_fused_batch(scheduled),
                                self._replicated)
         self._rng, step_key = jax.random.split(self._rng)
-        ids_dev, acc_dev, drafts_dev, self.kv_cache = self._spec_fn(
+        ids_dev, acc_dev, drafts_dev, lp_dev, top_dev, self.kv_cache = fn(
             self.params, self.draft_params, self.kv_cache, batch, step_key)
         # ONE batched fetch, exactly like the classic step's: ids +
-        # accepted counts + next drafts in a single tunnel round trip.
-        # llmd: ignore[JIT] the one intended spec-step host sync (batched)
-        fetched = jax.device_get([ids_dev, acc_dev, drafts_dev])
-        ids, accepted, drafts = (np.asarray(x) for x in fetched)
+        # accepted counts + next drafts (+ optional logprob arrays) in a
+        # single tunnel round trip.
+        fetch = [ids_dev, acc_dev, drafts_dev] \
+            + ([lp_dev] if want_lp else []) \
+            + (list(top_dev) if top_dev is not None else [])
+        # llmd: ignore[JIT] the one intended fused-step host sync (batched)
+        fetched = jax.device_get(fetch)
+        ids = np.asarray(fetched[0])
+        accepted = np.asarray(fetched[1])
+        drafts = np.asarray(fetched[2])
+        logprobs = np.asarray(fetched[3]) if want_lp else None
+        top = (np.asarray(fetched[-2]), np.asarray(fetched[-1])) \
+            if top_dev is not None else None
         self._step_count += 1
 
         outputs: List[RequestOutput] = []
         now = time.monotonic()
         total_drafted = total_accepted = 0
         for s, sr in enumerate(scheduled):
-            req = sr.request
+            req, n = sr.request, sr.num_new_tokens
             nd = sr.num_draft_tokens
+            # A TRUE decode entry has sampled at least one output token:
+            # without the output_token_ids check a 1-token final prefill
+            # chunk (1-token prompt, or a prompt that chunks to a 1-token
+            # tail) is indistinguishable from decode and would skip the
+            # first-token bookkeeping (TTFT, prompt/prefix counters, the
+            # engine.prefill trace phase).
+            is_decode = (n == 1 and bool(req.output_token_ids)
+                         and req.num_computed_tokens == req.num_tokens - 1
+                         and not req.do_remote_decode)
+            # All n+nd scheduled rows computed (and crossed the EP wire)
+            # whatever the verifier kept.
+            self._account_collective_bytes(n + nd)
+            if not is_decode:
+                # ---- prefill chunk (classic bookkeeping) ----
+                req.num_computed_tokens += n
+                produced_token = req.num_computed_tokens == req.num_tokens
+                self.kv_manager.cache_full_blocks(req)
+                if not produced_token:
+                    continue          # mid-prefill chunk: sample discarded
+                if req.num_computed_tokens <= req.num_prompt_tokens:
+                    # Prefill just completed.
+                    self.metrics.prompt_tokens.inc(req.num_prompt_tokens)
+                    if req.num_cached_prompt_tokens:
+                        self.metrics.prefix_cache_hits.inc(
+                            req.num_cached_prompt_tokens)
+                    self.metrics.prefix_cache_queries.inc(
+                        req.num_prompt_tokens)
+                    if req.first_token_time is None:
+                        req.first_token_time = now
+                        self.metrics.time_to_first_token.observe(
+                            now - req.arrival_time)
+                        self._trace_phase(
+                            req, "engine.prefill",
+                            "first_decode" if req.do_remote_prefill
+                            else "prefill",
+                            req.first_schedule_time or req.arrival_time,
+                            now,
+                            cached_tokens=req.num_cached_prompt_tokens
+                            or None,
+                            resume_offset=req.resume_offset or None,
+                            restored_tokens=req.resume_restored_tokens
+                            or None)
+                    if req.do_remote_decode:
+                        # PD producer: stop here, pin blocks, publish
+                        # transfer params.
+                        outputs.append(self._finish_remote_prefill(
+                            req, int(ids[s, 0])))
+                        continue
+                else:
+                    if req.last_token_time is not None:
+                        self.metrics.inter_token_latency.observe(
+                            now - req.last_token_time)
+                req.last_token_time = now
+                token = int(ids[s, 0])
+                req.output_token_ids.append(token)
+                self.metrics.generation_tokens.inc()
+                finish = self._check_stop(req, token)
+                top_lp = None
+                if (req.sampling.logprobs or 0) > 0 and top is not None:
+                    n_top = min(int(req.sampling.logprobs),
+                                top[0].shape[1])
+                    top_lp = [{int(top[0][s, j]): float(top[1][s, j])
+                               for j in range(n_top)}]
+                outputs.append(RequestOutput(
+                    req.request_id, [token], finish is not None,
+                    finish_reason=finish,
+                    logprobs=([float(logprobs[s])]
+                              if req.sampling.logprobs is not None
+                              else None),
+                    top_logprobs=top_lp))
+                if finish is not None:
+                    self.scheduler.finish(req, RequestState(finish))
+                    self._spec_forget(req.request_id)
+                    self.metrics.request_success.labels(
+                        model_name=self.metrics.model_name,
+                        finished_reason=finish).inc()
+                    self.metrics.e2e_request_latency.observe(
+                        now - req.arrival_time)
+                    self._trace_phase(
+                        req, "engine.decode", "decode",
+                        req.first_token_time or now, now,
+                        n_tokens=len(req.output_token_ids), finish=finish)
+                else:
+                    # The fused program drafted from this row's sampled
+                    # first token — the request's next (decode) step runs
+                    # spec-armed immediately instead of one plain round.
+                    req.spec_drafts = [int(tk) for tk in drafts[s]]
+                    req.spec_drafts_at = req.num_tokens
+                continue
+            # ---- decode row (draft-and-verify bookkeeping) ----
             a = min(int(accepted[s]), nd)
             total_drafted += nd
             total_accepted += a
@@ -1095,9 +1296,6 @@ class EngineCore:
                 if finish is not None:
                     break               # tokens past a stop are discarded
             self.metrics.generation_tokens.inc(len(new_tokens))
-            # All 1+nd scheduled rows computed (and crossed the EP wire)
-            # whatever the verifier kept.
-            self._account_collective_bytes(1 + nd)
             if req.last_token_time is not None:
                 self.metrics.inter_token_latency.observe(
                     (now - req.last_token_time) / max(1, len(new_tokens)))
@@ -1106,12 +1304,25 @@ class EngineCore:
             # them if any non-spec path appends tokens first.  The
             # adaptive depth is read fresh from the tracker at the next
             # schedule pass (_spec_lookahead), not cached on the request.
-            req.spec_drafts = [int(t) for t in drafts[s]]
+            req.spec_drafts = [int(tk) for tk in drafts[s]]
             req.spec_drafts_at = req.num_tokens
             self.kv_manager.cache_full_blocks(req)
+            # Top-N alternatives: a logprobs>0 row never drafts
+            # (_spec_lookahead), so it emits exactly slot 0's token and
+            # the slot-0 top arrays are its alternatives.
+            top_lp = None
+            if (req.sampling.logprobs or 0) > 0 and top is not None:
+                n_top = min(int(req.sampling.logprobs), top[0].shape[1])
+                top_lp = [{int(top[0][s, j]): float(top[1][s, j])
+                           for j in range(n_top)}]
             outputs.append(RequestOutput(
                 req.request_id, new_tokens, finish is not None,
-                finish_reason=finish))
+                finish_reason=finish,
+                logprobs=([float(logprobs[s])]
+                          if logprobs is not None
+                          and req.sampling.logprobs is not None
+                          else None),
+                top_logprobs=top_lp))
             if finish is not None:
                 self.scheduler.finish(req, RequestState(finish))
                 self._spec_forget(req.request_id)
@@ -1129,18 +1340,32 @@ class EngineCore:
                 # content (plus the pending token's slot) return to the
                 # pool THIS step.
                 self.kv_manager.trim_request(req, req.num_tokens)
+        # Step composition: decode load includes the verify rows (they
+        # cost compute like real tokens); everything here is host-side
+        # arithmetic over scheduler metadata — no new syncs.
+        decode_load = sched.decode_tokens + sched.spec_tokens
+        if sched.prefill_tokens:
+            self.metrics.step_prefill_tokens.inc(sched.prefill_tokens)
+        if decode_load:
+            self.metrics.step_decode_tokens.inc(decode_load)
+        self.step_time_model.observe(
+            sched.prefill_tokens, decode_load, (now - step_t0) * 1e3)
         # Step-boundary span from the clock reads already bracketing the
-        # one batched fetch — drafted/accepted attribution rides the
-        # span, no extra sync.
+        # one batched fetch — drafted/accepted and prefill/decode token
+        # attribution ride the span, no extra sync.
         traced = next((sr.request for sr in scheduled
                        if sr.request.trace_ctx is not None), None)
         if traced is not None:
+            kind = ("decode" if sched.prefill_tokens == 0
+                    else "prefill" if decode_load == 0 else "mixed")
             self.tracer.record_span(
                 "engine.step", self._mono_to_epoch(step_t0),
                 self._mono_to_epoch(now), parent=traced.trace_ctx,
-                step=self._step_count, kind="decode", spec=True,
-                n_seqs=len(scheduled), drafted=total_drafted,
-                accepted=total_accepted)
+                step=self._step_count, kind=kind, spec=True, fused=True,
+                n_seqs=len(scheduled),
+                prefill_tokens=sched.prefill_tokens,
+                decode_tokens=decode_load,
+                drafted=total_drafted, accepted=total_accepted)
         self._update_queue_metrics()
         return outputs
 
@@ -1399,30 +1624,15 @@ class EngineCore:
             return outputs
 
         if self._spec_fn is not None:
-            # A TRUE decode entry has sampled at least one output token:
-            # without the output_token_ids check a 1-token final prefill
-            # chunk (1-token prompt, or a prompt that chunks to a 1-token
-            # tail) is indistinguishable from decode and would skip the
-            # classic path's first-token bookkeeping (TTFT, prompt/prefix
-            # counters, the engine.prefill trace phase).
-            if all(sr.num_new_tokens == 1
-                   and sr.request.output_token_ids
-                   and sr.request.num_computed_tokens
-                   == sr.request.num_tokens - 1
-                   and not sr.request.do_remote_decode
-                   and sr.request.sampling.logprobs is None
-                   for sr in sched.scheduled):
-                outputs.extend(self._run_spec(sched))
-                return outputs
-            # Mixed round (a prefill chunk or logprobs request joined):
-            # fall back to the classic path and roll back the scheduler's
-            # optimistic draft-token block allocations.
-            for sr in sched.scheduled:
-                if sr.num_draft_tokens:
-                    self.kv_manager.trim_request(
-                        sr.request,
-                        sr.request.num_computed_tokens + sr.num_new_tokens)
-                    sr.num_draft_tokens = 0
+            # Fused mixed round: whatever this pass scheduled — prefill
+            # chunks, plain decodes, draft-verify rows, logprobs rows —
+            # runs as ONE device program.  There is no classic fallback
+            # anymore (and so no draft-allocation rollback): spec decode
+            # stays on under continuous prefill traffic, and a prefill
+            # chunk rides the same per-layer expert-weight stream the
+            # decodes already pay for.
+            outputs.extend(self._run_fused(sched))
+            return outputs
 
         K = self._try_multistep(sched)
         if K is not None:
@@ -1471,7 +1681,9 @@ class EngineCore:
                 self._mono_to_epoch(time.monotonic()),
                 parent=traced.trace_ctx, step=self._step_count,
                 kind="decode" if max_new == 1 else "prefill",
-                n_seqs=len(scheduled), n_tokens=sched.total_tokens)
+                n_seqs=len(scheduled), n_tokens=sched.total_tokens,
+                prefill_tokens=sched.prefill_tokens,
+                decode_tokens=sched.decode_tokens, fused=False)
         if self.eplb is not None:
             # Record routed logical ids (sampled; padding rows excluded so
             # the zero-embedding's favorite expert doesn't skew the stats)
@@ -1555,6 +1767,16 @@ class EngineCore:
                     req.first_token_time or now, now,
                     n_tokens=len(req.output_token_ids), finish=finish)
 
+        # Step composition counters + the step-latency model's sample,
+        # all from scheduler metadata and the clock reads already taken
+        # around the one batched fetch — no new host syncs.
+        if sched.prefill_tokens:
+            self.metrics.step_prefill_tokens.inc(sched.prefill_tokens)
+        if sched.decode_tokens:
+            self.metrics.step_decode_tokens.inc(sched.decode_tokens)
+        self.step_time_model.observe(
+            sched.prefill_tokens, sched.decode_tokens,
+            (now - step_t0) * 1e3)
         self._update_queue_metrics()
         return outputs
 

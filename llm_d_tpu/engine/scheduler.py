@@ -46,6 +46,14 @@ class SchedulerOutput:
     scheduled: List[ScheduledRequest]
     preempted: List[Request]
     total_tokens: int
+    # Step composition under decode-priority budgeting: decode entries'
+    # mandatory tokens, their speculative draft tokens (on top), and
+    # prefill-chunk tokens.  total_tokens == decode + prefill; the engine
+    # feeds these to the step span, the step-composition counters and the
+    # step-latency model without recomputing them from the rows.
+    decode_tokens: int = 0
+    spec_tokens: int = 0
+    prefill_tokens: int = 0
 
     @property
     def empty(self) -> bool:
@@ -80,6 +88,16 @@ class Scheduler:
         # real work for speculative capacity would be a net loss) and the
         # engine rolls the rejected tail back after verification.
         self.spec_lookahead: Optional[Callable[[Request], int]] = None
+        # Decode-priority chunk budgeting (set by the engine): callable
+        # (decode_tokens_funded) -> per-chunk prefill token cap for this
+        # pass, or None for "budget-bound only" (the historical behavior).
+        # Called AFTER decode entries are funded, so an adaptive policy can
+        # size prefill chunks to the decode load actually in the step.
+        self.prefill_chunk_cap: Optional[
+            Callable[[int], Optional[int]]] = None
+        # Composition of the most recent schedule() pass (tests and the
+        # engine's observability read this without re-deriving it).
+        self.last_schedule_stats: Dict[str, int] = {}
 
     # ---------- queue ops ----------
 
@@ -160,6 +178,87 @@ class Scheduler:
                 self.num_deadline_evictions += 1
                 expired_out.append(req)
 
+    def _schedule_running(self, req: Request, budget: int,
+                          cap: Optional[int],
+                          scheduled: List[ScheduledRequest],
+                          preempted: List[Request],
+                          preempted_now: set,
+                          scheduled_ids: set) -> Tuple[int, int]:
+        """Fund one running request (decode entry or in-flight prefill
+        chunk) out of ``budget``; returns ``(n, spec_n)`` actually
+        scheduled (``(0, 0)`` when nothing fit).  Only what is returned
+        may be charged to the budget — a request that bails leaves its
+        slack for later chunks (budget conservation)."""
+        remaining = req.num_tokens - req.num_computed_tokens
+        if remaining <= 0:
+            remaining = 1       # decode: compute the next token's KV
+        n = min(remaining, budget)
+        if cap is not None:
+            n = min(n, max(int(cap), 1))
+        # Terminal path: a request whose block demand exceeds the whole
+        # pool can never run — fail it instead of livelocking with n=0
+        # forever (has_work() true, no progress, no client error).
+        needed = -(-(req.num_computed_tokens + n) // self.kv.block_size)
+        if needed > self.kv.max_request_blocks:
+            self.running.remove(req)
+            self.kv.free(req)
+            req.state = RequestState.FINISHED_ABORTED
+            preempted.append(req)
+            return 0, 0
+        while True:
+            ok = self.kv.allocate(req, req.num_computed_tokens + n)
+            if ok is not None:
+                break
+            if self._preempt_for(req, preempted_now, scheduled_ids):
+                continue
+            # Nothing to preempt: shrink the chunk to the blocks that are
+            # actually free so mid-prefill requests keep making progress
+            # (partial pools must not stall the pass).
+            fit = ((len(req.block_ids) + self.kv.region_free_blocks(
+                self.kv.region_of_request(req)))
+                * self.kv.block_size) - req.num_computed_tokens
+            if fit >= n:
+                # Bookkeeping race (free-list vs region accounting, e.g.
+                # blocks parked in the evictor): the pool claims ``n``
+                # fits but allocate refused.  Shrink by one block and
+                # retry instead of dropping the whole chunk — strictly
+                # decreasing, so the loop terminates, and the tokens this
+                # request ends up not using were never charged, so they
+                # remain in the budget for later prefill chunks.
+                fit = n - self.kv.block_size
+            n = max(fit, 0)
+            if n <= 0:
+                break
+        if n <= 0:
+            # Nothing schedulable and nothing preemptable: if no other
+            # request holds reclaimable blocks this will never resolve —
+            # unless blocks are pinned outside the scheduler (PD transfer
+            # in flight), whose async release will unblock us.
+            if not scheduled and len(self.running) == 1 \
+                    and not self.kv.can_allocate(
+                        1, self.kv.region_of_request(req)) \
+                    and self.external_pinned_blocks() == 0:
+                self.running.remove(req)
+                self.kv.free(req)
+                req.state = RequestState.FINISHED_ABORTED
+                preempted.append(req)
+            return 0, 0
+        spec_n = 0
+        if (self.spec_lookahead is not None and n == 1
+                and req.num_computed_tokens == req.num_tokens - 1):
+            # Decode entry under spec decode: schedule up to K draft
+            # tokens on top of the mandatory one.  Drafts pay token
+            # budget like real compute and shrink to the free block
+            # pool — speculation never preempts or blocks real work.
+            spec_n = min(max(0, int(self.spec_lookahead(req))),
+                         budget - n)
+            while spec_n > 0 and self.kv.allocate(
+                    req, req.num_computed_tokens + n + spec_n) is None:
+                spec_n -= 1
+        scheduled.append(ScheduledRequest(req, n, num_draft_tokens=spec_n))
+        scheduled_ids.add(req.request_id)
+        return n, spec_n
+
     def schedule(self) -> SchedulerOutput:
         scheduled: List[ScheduledRequest] = []
         preempted: List[Request] = []
@@ -169,78 +268,55 @@ class Scheduler:
         # step: re-admission would recreate the memory pressure that forced
         # the preemption (thrash).
         preempted_now: set = set()
-
-        # 1. Running requests (decodes and in-flight chunked prefills).
         scheduled_ids: set = set()
-        for req in list(self.running):
+        decode_tokens = spec_tokens = prefill_tokens = 0
+
+        # 1. Decode entries first (decode-priority budgeting): every
+        # in-flight stream's next token — plus its speculative lookahead —
+        # is funded before ANY prefill chunk sees the budget, so a large
+        # chunk can never push a decode out of the step and stall TPOT.
+        # A decode entry has emitted output and only its last token's KV
+        # left to compute (the engine's per-row is_decode predicate);
+        # everything else running is an in-flight prefill chunk.
+        running = list(self.running)
+
+        def is_decode(r):
+            return (bool(r.output_token_ids)
+                    and r.num_tokens - r.num_computed_tokens <= 1
+                    and not r.do_remote_decode)
+
+        decodes = [r for r in running if is_decode(r)]
+        chunks = [r for r in running if not is_decode(r)]
+        for req in decodes:
             if budget <= 0:
                 break
             if req.request_id in preempted_now:
                 continue        # evicted by an earlier request in this pass
-            remaining = req.num_tokens - req.num_computed_tokens
-            if remaining <= 0:
-                remaining = 1       # decode: compute the next token's KV
-            n = min(remaining, budget)
-            # Terminal path: a request whose block demand exceeds the whole
-            # pool can never run — fail it instead of livelocking with n=0
-            # forever (has_work() true, no progress, no client error).
-            needed = -(-(req.num_computed_tokens + n) // self.kv.block_size)
-            if needed > self.kv.max_request_blocks:
-                self.running.remove(req)
-                self.kv.free(req)
-                req.state = RequestState.FINISHED_ABORTED
-                preempted.append(req)
-                continue
-            while True:
-                ok = self.kv.allocate(req, req.num_computed_tokens + n)
-                if ok is not None:
-                    break
-                if self._preempt_for(req, preempted_now, scheduled_ids):
-                    continue
-                # Nothing to preempt: shrink the chunk to the blocks that are
-                # actually free so mid-prefill requests keep making progress
-                # (partial pools must not stall the pass).
-                fit = ((len(req.block_ids) + self.kv.region_free_blocks(
-                    self.kv.region_of_request(req)))
-                    * self.kv.block_size) - req.num_computed_tokens
-                if fit >= n:        # bookkeeping race; bail out of this req
-                    n = 0
-                    break
-                n = max(fit, 0)
-                if n <= 0:
-                    break
-            if n <= 0:
-                # Nothing schedulable and nothing preemptable: if no other
-                # request holds reclaimable blocks this will never resolve —
-                # unless blocks are pinned outside the scheduler (PD transfer
-                # in flight), whose async release will unblock us.
-                if not scheduled and len(self.running) == 1 \
-                        and not self.kv.can_allocate(
-                            1, self.kv.region_of_request(req)) \
-                        and self.external_pinned_blocks() == 0:
-                    self.running.remove(req)
-                    self.kv.free(req)
-                    req.state = RequestState.FINISHED_ABORTED
-                    preempted.append(req)
-                continue
-            spec_n = 0
-            if (self.spec_lookahead is not None and n == 1
-                    and req.num_computed_tokens == req.num_tokens - 1):
-                # Decode entry under spec decode: schedule up to K draft
-                # tokens on top of the mandatory one.  Drafts pay token
-                # budget like real compute and shrink to the free block
-                # pool — speculation never preempts or blocks real work.
-                spec_n = min(max(0, int(self.spec_lookahead(req))),
-                             budget - n)
-                while spec_n > 0 and self.kv.allocate(
-                        req, req.num_computed_tokens + n + spec_n) is None:
-                    spec_n -= 1
+            n, spec_n = self._schedule_running(
+                req, budget, None, scheduled, preempted,
+                preempted_now, scheduled_ids)
             budget -= n + spec_n
-            scheduled.append(ScheduledRequest(
-                req, n, num_draft_tokens=spec_n))
-            scheduled_ids.add(req.request_id)
+            decode_tokens += n
+            spec_tokens += spec_n
 
-        # 2. Waiting requests, FIFO within (criticality tier, priority)
+        # 2. In-flight chunked prefills spend what the decodes left,
+        # per-chunk-capped by the engine's policy (fixed LLMD_PREFILL_CHUNK
+        # or the step-latency model sized against the funded decode load).
+        cap: Optional[int] = None
+        if self.prefill_chunk_cap is not None:
+            cap = self.prefill_chunk_cap(decode_tokens + spec_tokens)
+        for req in chunks:
+            if budget <= 0:
+                break
+            if req.request_id in preempted_now:
+                continue
+            n, _ = self._schedule_running(
+                req, budget, cap, scheduled, preempted,
+                preempted_now, scheduled_ids)
+            budget -= n
+            prefill_tokens += n
+
+        # 3. Waiting requests, FIFO within (criticality tier, priority)
         # (lower value = more important, matching InferenceObjective; the
         # SLO class is the outer tier, per-request priority the inner).
         pending = sorted(self.waiting,
@@ -276,6 +352,9 @@ class Scheduler:
                         0, n_cached - req.num_prompt_tokens)
             remaining = req.num_tokens - req.num_computed_tokens
             n = min(remaining, budget)
+            if cap is not None:
+                # First chunks obey the same per-chunk cap as running ones.
+                n = min(n, max(int(cap), 1))
             if n <= 0:
                 continue
             ok = self.kv.allocate(req, req.num_computed_tokens + n, reuse)
@@ -297,11 +376,21 @@ class Scheduler:
             self.running.append(req)
             req.state = RequestState.RUNNING
             budget -= n
+            prefill_tokens += n
             scheduled.append(ScheduledRequest(req, n, is_first_schedule=first))
 
+        self.last_schedule_stats = {
+            "decode_tokens": decode_tokens,
+            "spec_tokens": spec_tokens,
+            "prefill_tokens": prefill_tokens,
+            "chunk_cap": -1 if cap is None else int(cap),
+            "budget_left": budget,
+        }
         return SchedulerOutput(
             scheduled=scheduled, preempted=preempted,
-            total_tokens=sum(s.num_new_tokens for s in scheduled))
+            total_tokens=sum(s.num_new_tokens for s in scheduled),
+            decode_tokens=decode_tokens, spec_tokens=spec_tokens,
+            prefill_tokens=prefill_tokens)
 
     def finish(self, request: Request, state: RequestState) -> None:
         request.state = state

@@ -241,6 +241,16 @@ DENSE_DISPATCH_MAX_T = 512
 # now takes over instead of the grouped one.  Re-measure on chip via
 # LLMD_MOE_DENSE_KERNEL_MAX_T / LLMD_MOE_GROUPED_MIN_T (invalid values
 # fall back to these defaults rather than crashing the serving path).
+#
+# Fused mixed rounds (r15, engine chunked-prefill/decode fusion): the
+# engine now lands prefill-chunk tokens AND decode/verify tokens in ONE
+# program, so these crossovers apply to the COMBINED per-step T — a
+# 64-row decode batch joined by a 448-token prefill chunk dispatches
+# once at T=512, not twice at T=64 and T=448.  That is the prefill-MFU
+# lever: each layer's expert weights stream from HBM ONCE per step and
+# the prefill GEMM rows amortize the weight traffic the decode rows were
+# already paying (scripts/kernel_bench.py --mixed measures fused-vs-
+# two-program tok/s across the chunk-size x decode-batch plane).
 DENSE_INT8_MAX_T = 64
 GROUPED_INT8_MIN_T = 512
 
@@ -835,7 +845,10 @@ def expert_ffn(
     ``_dense_expert_ffn``), sorted grouped GEMM above it (prefill).
     Multi-device: sparse all-to-all dispatch by default
     (``LLMD_MOE_DISPATCH=psum`` forces the oracle path; see module
-    docstring).
+    docstring).  One call serves whatever population the engine batched
+    — under fused mixed rounds (r15) that is prefill-chunk AND
+    decode/verify tokens together, so each layer's expert weights
+    stream once for both (the regime thresholds see the combined T).
 
     ``quant`` carries int8 expert payloads END TO END: on the TPU
     single-device path they reach the Pallas kernel family (dense

@@ -132,6 +132,74 @@ class SpecAcceptanceTracker:
         self._rate.pop(request_id, None)
 
 
+class StepTimeModel:
+    """Online linear step-latency model for the engine's chunk budgeting:
+
+        step_ms  ~=  base + a_p * prefill_tokens + a_d * decode_tokens
+
+    fit closed-form (ridge over accumulated normal equations — O(1)
+    memory, every step observes, no retrain loop) from the wall-clock
+    reads the engine step already takes around its one batched fetch, so
+    feeding the model adds zero host syncs.  ``chunk_for`` answers the
+    scheduler's question: the largest prefill chunk whose PREDICTED step
+    time stays under the operator's target (LLMD_STEP_TIME_TARGET_MS)
+    given the decode tokens already funded — decode-priority budgeting
+    backs the chunk off, never the decodes.
+    """
+
+    def __init__(self, min_samples: int = 16, l2: float = 1e-3) -> None:
+        self.min_samples = min_samples
+        self.l2 = l2
+        self._xtx = np.zeros((3, 3))
+        self._xty = np.zeros(3)
+        self.num_observed = 0
+        self._coef: Optional[np.ndarray] = None
+
+    def observe(self, prefill_tokens: int, decode_tokens: int,
+                step_ms: float) -> None:
+        x = np.asarray([1.0, float(prefill_tokens), float(decode_tokens)])
+        self._xtx += np.outer(x, x)
+        self._xty += x * float(step_ms)
+        self.num_observed += 1
+        self._coef = None            # re-solved lazily on next predict
+
+    @property
+    def trained(self) -> bool:
+        return self.num_observed >= self.min_samples
+
+    def predict(self, prefill_tokens: int, decode_tokens: int) -> float:
+        """Predicted step wall-clock (ms); 0.0 when untrained."""
+        if not self.trained:
+            return 0.0
+        if self._coef is None:
+            A = self._xtx + self.l2 * np.eye(3)
+            self._coef = np.linalg.solve(A, self._xty)
+        x = np.asarray([1.0, float(prefill_tokens), float(decode_tokens)])
+        return float(max(0.0, self._coef @ x))
+
+    def chunk_for(self, decode_tokens: int, target_ms: float,
+                  lo: int, hi: int) -> int:
+        """Largest prefill chunk in [lo, hi] whose predicted step time
+        stays under ``target_ms`` at the given decode load.  Untrained ->
+        ``hi`` (no evidence to cut prefill throughput on); even ``lo``
+        over target -> ``lo`` (the chunk floor keeps prefills making
+        progress — starving them entirely would deadlock admission)."""
+        if not self.trained or target_ms <= 0 or hi <= lo:
+            return hi
+        if self.predict(hi, decode_tokens) <= target_ms:
+            return hi
+        if self.predict(lo, decode_tokens) > target_ms:
+            return lo
+        lo_b, hi_b = lo, hi          # invariant: lo_b under, hi_b over
+        while lo_b + 1 < hi_b:
+            mid = (lo_b + hi_b) // 2
+            if self.predict(mid, decode_tokens) <= target_ms:
+                lo_b = mid
+            else:
+                hi_b = mid
+        return lo_b
+
+
 class TrainingStore:
     """Capped sample buckets + retrain policy for both targets."""
 

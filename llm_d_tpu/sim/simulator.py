@@ -23,6 +23,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import random
 import time
 import uuid as uuid_mod
@@ -70,6 +71,8 @@ class SimConfig:
         seed: int = 0,
         spec_k: Optional[int] = None,
         spec_acceptance: float = 0.7,
+        prefill_chunk: Optional[int] = None,
+        step_prefill_token_ms: float = 0.0,
     ) -> None:
         self.model = model
         self.ttft_ms = ttft_ms
@@ -84,6 +87,15 @@ class SimConfig:
         # seeded per-draft acceptance rate of the sim's acceptance model.
         self.spec_k = spec_k
         self.spec_acceptance = spec_acceptance
+        # Mixed-round fusion mirror (round 15): the fused engine folds
+        # prefill-chunk tokens into the SAME step as decode/verify rows,
+        # so a decode step overlapping an in-flight prefill pays a
+        # chunk-size-dependent latency tax.  prefill_chunk = None
+        # resolves the engine's LLMD_PREFILL_CHUNK knob ("auto" -> 0 =
+        # unchunked: the sim has no step-time model to budget with);
+        # step_prefill_token_ms = 0 keeps timing byte-identical.
+        self.prefill_chunk = prefill_chunk
+        self.step_prefill_token_ms = step_prefill_token_ms
 
 
 class InferenceSimulator:
@@ -124,6 +136,25 @@ class InferenceSimulator:
                                     ("auto", "off")) != "off" else 0)
         self.spec_k = max(0, int(spec_k))
         self.spec_acceptance = config.spec_acceptance
+        # Mixed-round fusion mirror (round 15): the engine's fused step
+        # carries prefill-chunk rows alongside decode/verify rows, so a
+        # decode TPOT stretches by the prefill tokens sharing its round.
+        # The sim mirrors that as a per-step surcharge proportional to
+        # the chunk size and the number of in-flight prefills (tracked
+        # around the TTFT sleep).  Defaults are inert: surcharge 0 ms.
+        chunk = config.prefill_chunk
+        if chunk is None:
+            raw = os.environ.get("LLMD_PREFILL_CHUNK", "auto")
+            try:
+                chunk = max(1, int(raw))
+            except ValueError:
+                # "auto" (or garbage): the engine would size chunks from
+                # its step-time model; the sim has none, so unchunked.
+                chunk = 0
+        self.prefill_chunk = max(0, int(chunk))
+        self.step_prefill_token_ms = max(
+            0.0, float(config.step_prefill_token_ms))
+        self._prefill_inflight = 0
         self._running = 0
         self._waiting = 0
         self._blocks_used = 0          # simulated KV blocks held
@@ -273,6 +304,27 @@ class InferenceSimulator:
         self._slots.release()
         self._update_gauges()
 
+    # One prompt's worth of tokens — the prefill cost a fused round pays
+    # per in-flight prefill when chunking is OFF (the engine would put
+    # the whole remaining prompt in one round).  Any configured chunk is
+    # smaller, which is exactly the decode-priority budgeting story.
+    _UNCHUNKED_TOKENS = 512
+
+    def _mixed_step_extra_ms(self) -> float:
+        """Per-step latency surcharge a decode step pays for the
+        prefill-chunk tokens fused into the same round (round 15).
+
+        Pure function of (config, in-flight prefill count) so tests can
+        assert the policy structurally without timing sleeps: 0 when the
+        mirror is off or no prefill overlaps; otherwise one chunk per
+        in-flight prefill, ``step_prefill_token_ms`` per token — smaller
+        chunks mean a smaller tax on every overlapped decode step."""
+        if self.step_prefill_token_ms <= 0.0 or self._prefill_inflight <= 0:
+            return 0.0
+        chunk = (self.prefill_chunk if self.prefill_chunk > 0
+                 else self._UNCHUNKED_TOKENS)
+        return self._prefill_inflight * chunk * self.step_prefill_token_ms
+
     async def stream_tokens(self, ticket: Dict[str, Any]):
         """Yields (token_index, token_text) at the simulated rate for an
         admitted ticket; releases the slot + blocks on exit.  A deadline
@@ -328,7 +380,13 @@ class InferenceSimulator:
                 # Restored resume skips the prompt+generated recompute;
                 # a tier miss replays it as a full prefill.
                 miss_frac = 0.0 if restored else 1.0
-            await asyncio.sleep(c.ttft_ms / 1e3 * max(miss_frac, 0.1))
+            # While this request prefills, overlapped decode steps pay
+            # the mixed-round surcharge (see _mixed_step_extra_ms).
+            self._prefill_inflight += 1
+            try:
+                await asyncio.sleep(c.ttft_ms / 1e3 * max(miss_frac, 0.1))
+            finally:
+                self._prefill_inflight -= 1
             self.metrics.prompt_tokens.inc(len(prompt_ids))
             self.metrics.time_to_first_token.observe(
                 time.monotonic() - arrival)
@@ -376,8 +434,9 @@ class InferenceSimulator:
                     self.metrics.spec_accepted_tokens.inc(
                         step_starts[i] - 1)
                 if emitted > 0 and (not step_starts or i in step_starts):
-                    await asyncio.sleep(c.tpot_ms / 1e3)
-                    self.metrics.inter_token_latency.observe(c.tpot_ms / 1e3)
+                    step_ms = c.tpot_ms + self._mixed_step_extra_ms()
+                    await asyncio.sleep(step_ms / 1e3)
+                    self.metrics.inter_token_latency.observe(step_ms / 1e3)
                 if deadline_epoch is not None \
                         and time.time() > deadline_epoch:
                     ticket["expired"] = True
@@ -720,6 +779,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--spec-acceptance", type=float, default=0.7,
                    help="seeded per-draft acceptance rate of the spec "
                         "mirror's acceptance model")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="mixed-round fusion mirror: prefill chunk size "
+                        "fused into each decode step (0 = unchunked); "
+                        "default resolves LLMD_PREFILL_CHUNK")
+    p.add_argument("--step-prefill-token-ms", type=float, default=0.0,
+                   help="per-token latency surcharge a decode step pays "
+                        "for prefill tokens sharing its fused round "
+                        "(0 = off, timing unchanged)")
     args = p.parse_args(argv)
 
     cfg = SimConfig(
@@ -727,7 +794,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         tpot_ms=args.inter_token_latency, max_num_seqs=args.max_num_seqs,
         num_blocks=args.num_blocks, block_size=args.block_size,
         startup_delay_s=args.startup_delay, spec_k=args.spec_k,
-        spec_acceptance=args.spec_acceptance)
+        spec_acceptance=args.spec_acceptance,
+        prefill_chunk=args.prefill_chunk,
+        step_prefill_token_ms=args.step_prefill_token_ms)
     logging.basicConfig(level=logging.INFO)
     web.run_app(build_sim_server(cfg).build_app(),
                 host=args.host, port=args.port)

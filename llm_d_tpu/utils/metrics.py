@@ -56,6 +56,14 @@ REQUEST_PHASE_METRIC = "llmd_tpu:request_phase_seconds"
 # output and lands in vllm:generation_tokens_total like any other).
 SPEC_DRAFT_METRIC = "llmd_tpu:spec_draft_tokens_total"
 SPEC_ACCEPTED_METRIC = "llmd_tpu:spec_accepted_tokens_total"
+# Fused mixed-round step composition (chunked-prefill/decode fusion):
+# prefill-chunk tokens vs decode(+verify) tokens computed per engine
+# step.  rate(prefill)/(rate(prefill)+rate(decode)) is the prefill
+# share — the dashboard signal that decode-priority chunk budgeting is
+# holding TPOT while prefill chunks ride the decode rounds' weight
+# stream.
+STEP_PREFILL_TOKENS_METRIC = "llmd_tpu:step_prefill_tokens_total"
+STEP_DECODE_TOKENS_METRIC = "llmd_tpu:step_decode_tokens_total"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -194,6 +202,16 @@ class EngineMetrics:
         self.spec_accepted_tokens = counter(
             SPEC_ACCEPTED_METRIC,
             "Draft tokens the target model accepted (emitted verbatim).")
+        # Step composition (see the STEP_* constants above): incremented
+        # host-side from scheduler metadata on every engine step, classic
+        # and fused alike — never a device sync.
+        self.step_prefill_tokens = counter(
+            STEP_PREFILL_TOKENS_METRIC,
+            "Prefill-chunk tokens computed per engine step.")
+        self.step_decode_tokens = counter(
+            STEP_DECODE_TOKENS_METRIC,
+            "Decode + speculative-verify tokens computed per engine "
+            "step.")
 
     def observe_phase(self, phase: str, criticality: str,
                       seconds: float) -> None:

@@ -57,6 +57,13 @@ python -m pytest tests/test_collective_quant.py -q
 # kill switch, chaos resume during spec decode with exact multi-token
 # journal offsets, and the no-new-host-sync JIT meta-gate).
 python -m pytest tests/test_spec_decode.py -q
+# Mixed-round fusion contract fail-fast (round 15: ONE fused program for
+# prefill-chunk + decode + spec-verify rows — byte-identical parity vs
+# solo runs (greedy AND seeded, spec on AND off), spec-stays-on across
+# prefill joins with zero draft rollbacks, rejected-draft leak-freedom
+# inside fused rounds, decode-priority budget invariants, adaptive chunk
+# sizing, and the LLMD_PREFILL_CHUNK=<n> kill switch).
+python -m pytest tests/test_mixed_fusion.py -q
 python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_mla_quant.py \
@@ -64,4 +71,5 @@ python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_stream_recovery.py \
     --ignore=tests/test_llmd_race.py \
     --ignore=tests/test_spec_decode.py \
+    --ignore=tests/test_mixed_fusion.py \
     --ignore=tests/test_tracing.py

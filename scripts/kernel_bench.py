@@ -508,6 +508,82 @@ def run_spec(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Mixed-round fusion sweep (round 15): ONE streamed int8 program over the
+# COMBINED prefill-chunk + decode/verify token population vs the same work
+# as TWO programs (streamed over the chunk, plus the decode-regime kernel
+# over the decode/verify rows).  The fused engine batches both populations
+# into a single expert_ffn call per layer, so every layer's expert weights
+# stream from HBM once instead of once per program — this sweep measures
+# that amortization at the ops level (the engine-level companion is
+# bench.py's gated ``moe_mixed_tok_s_bs256``).  --interpret runs tiny
+# shapes on CPU so tier-1 exercises the sweep glue (timings flagged
+# invalid).
+# ---------------------------------------------------------------------------
+
+def _decode_regime(decode_T, args) -> str:
+    """The kernel the two-program baseline runs over the decode/verify
+    rows alone — the same small-T regime ladder ops.moe dispatches on."""
+    if decode_T <= args.dense_max_t:
+        return "dense"
+    if decode_T <= args.routed_max_t:
+        return "routed"
+    return "streamed"
+
+
+def run_mixed(args) -> dict:
+    if args.interpret:
+        E, H, I, k = 8, 256, 128, 2
+        chunk_sweep = [16, 32]
+        decode_s, spec_k = 4, 1
+        iters = args.iters or 1
+        streamed_chunk_t = 16    # force multi-chunk even at tiny T
+    else:
+        E, H, I, k = 64, 2048, 512, 8       # deepseek-v3-bench experts
+        chunk_sweep = [256, 512, 1024, 2048]
+        decode_s, spec_k = 256, 4           # the gated bs256 decode point
+        iters = args.iters or 10
+        streamed_chunk_t = None  # LLMD_MOE_PREFILL_CHUNK_T / default
+    if args.t_sweep:
+        chunk_sweep = [int(t) for t in args.t_sweep.split(",") if t]
+
+    paths = _paths(args.interpret, streamed_chunk_t)
+    Qv = spec_k + 1
+    decode_T = decode_s * Qv                # verify rows: K+1 slots each
+    points = []
+    for i, chunk_T in enumerate(chunk_sweep):
+        total_T = chunk_T + decode_T
+        fused_case = _build_case(
+            jax.random.PRNGKey(3 * i), total_T, E, H, I, k)
+        prefill_case = _build_case(
+            jax.random.PRNGKey(3 * i + 1), chunk_T, E, H, I, k)
+        decode_case = _build_case(
+            jax.random.PRNGKey(3 * i + 2), decode_T, E, H, I, k)
+        fused_ms = _time_ms(paths["streamed"](*fused_case), iters)
+        decode_path = _decode_regime(decode_T, args)
+        split_ms = (_time_ms(paths["streamed"](*prefill_case), iters)
+                    + _time_ms(paths[decode_path](*decode_case), iters))
+        points.append({
+            "chunk_T": chunk_T, "decode_S": decode_s, "total_T": total_T,
+            "decode_path": decode_path,
+            "ms": {"fused": round(fused_ms, 3),
+                   "split": round(split_ms, 3)},
+            "tok_s": {
+                "fused": round(1e3 * total_T / max(fused_ms, 1e-9), 1),
+                "split": round(1e3 * total_T / max(split_ms, 1e-9), 1)},
+        })
+    return {
+        "mode": "mixed",
+        "backend": jax.default_backend(),
+        "interpret": args.interpret,
+        "timings_valid": not args.interpret,
+        "shapes": {"E": E, "H": H, "I": I, "k": k,
+                   "spec_k": spec_k, "Qv": Qv},
+        "iters": iters,
+        "points": points,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interpret", action="store_true",
@@ -535,6 +611,12 @@ def main(argv=None) -> int:
                          "a fixed seeded acceptance (--spec-accept) "
                          "instead of the MoE kernel family; --interpret "
                          "runs the tiny model on CPU (glue smoke)")
+    ap.add_argument("--mixed", action="store_true",
+                    help="run the mixed-round fusion sweep (one streamed "
+                         "program over combined prefill-chunk + "
+                         "decode/verify tokens vs the same work as two "
+                         "programs) instead of the MoE kernel family; "
+                         "--t-sweep sets the chunk sizes")
     ap.add_argument("--k-sweep", type=str, default=None,
                     help="spec mode: comma-separated draft depths "
                          "(default 1,2,4,8 on chip; 1,2,4 interpreted)")
@@ -561,10 +643,11 @@ def main(argv=None) -> int:
                     help="also write the JSON document to this path")
     args = ap.parse_args(argv)
 
-    if args.paged or args.mla or args.a2a or args.spec:
+    if args.paged or args.mla or args.a2a or args.spec or args.mixed:
         doc = (run_paged(args) if args.paged
                else run_mla(args) if args.mla
-               else run_spec(args) if args.spec else run_a2a(args))
+               else run_spec(args) if args.spec
+               else run_mixed(args) if args.mixed else run_a2a(args))
         text = json.dumps(doc)
         print(text)
         if args.out:

@@ -943,3 +943,122 @@ def test_engine_death_fails_requests_instead_of_hanging(inject):
             ae.stop()
 
     asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+# ---------------------------------------------------------------------------
+# round 15: engine death MID-MIXED-ROUND (prefill chunks riding decode
+# steps) must still resume at exact offsets
+# ---------------------------------------------------------------------------
+
+def test_chaos_mid_mixed_round_kill_resumes_exact(inject):
+    """A sim fleet with the mixed-round mirror ACTIVE (prefill chunks
+    stretch concurrent decode steps via ``step_prefill_token_ms``) under
+    overlapping streaming load; a seeded mid-stream ``engine.step`` kill
+    lands while prefill and decode genuinely share rounds.  The PR 9
+    resume must splice at EXACT offsets: zero client-visible breaks,
+    clean continuity, byte-identical text, recovery recorded — chunked
+    prefill riding a decode round adds no new failure mode."""
+    import aiohttp
+    from test_stream_recovery import (
+        _cleanup, _metric_value, _start_app, free_port)
+    from llm_d_tpu.epp.service import build_gateway
+    from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+    from test_spec_decode import _sim_text, parse_stream_payload, \
+        verify_continuity
+
+    inj = inject()
+    inj.add_rule("engine.step", after=25, count=1)
+
+    async def run():
+        ports = [free_port() for _ in range(2)]
+        runners, sims = [], []
+        mixed_extras = []                 # surcharge values actually used
+        for i, port in enumerate(ports):
+            # Slow-ish TTFT keeps a prefill in flight across several
+            # concurrent decode steps -> real mixed rounds in the mirror.
+            srv = build_sim_server(SimConfig(
+                model=f"sim-{i}", ttft_ms=8.0, tpot_ms=2.0,
+                spec_k=4, spec_acceptance=0.8,
+                prefill_chunk=64, step_prefill_token_ms=0.02))
+            orig = srv.sim._mixed_step_extra_ms
+            def spy(orig=orig):
+                v = orig()
+                mixed_extras.append(v)
+                return v
+            srv.sim._mixed_step_extra_ms = spy
+            sims.append(srv.sim)
+            runners.append(await _start_app(srv.build_app(), port))
+        endpoints = [EndpointState(address=f"127.0.0.1:{p}")
+                     for p in ports]
+        gw = build_gateway(endpoints, scrape_interval_s=0.05,
+                           retry_attempts=3)
+        gw_port = free_port()
+        gw_runner = await _start_app(gw.build_app(), gw_port)
+        url = f"http://127.0.0.1:{gw_port}/v1/completions"
+        for _ in range(200):
+            if all(e.ready for e in gw.datastore.candidates()):
+                break
+            await asyncio.sleep(0.02)
+
+        max_tokens = 8
+        results = []
+        stop = asyncio.Event()
+
+        async def load_worker(sess, wid):
+            i = 0
+            while not stop.is_set():
+                i += 1
+                prompt = f"mixed chaos {wid} {i} tail"
+                try:
+                    async with sess.post(url, json={
+                            "prompt": prompt, "max_tokens": max_tokens,
+                            "stream": True}) as r:
+                        payload = await r.read()
+                        text, metas, done = parse_stream_payload(payload)
+                        results.append(
+                            (prompt, r.status, text, metas, done))
+                except aiohttp.ClientError as e:
+                    results.append((prompt, f"error:{type(e).__name__}",
+                                    "", [], False))
+                await asyncio.sleep(0.005)
+
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=30)) as sess:
+                workers = [asyncio.create_task(load_worker(sess, w))
+                           for w in range(3)]
+                for _ in range(600):
+                    await asyncio.sleep(0.02)
+                    if inj.stats().get("engine.step", {}).get(
+                            "fired", 0) >= 1 and len(results) > 20:
+                        break
+                await asyncio.sleep(0.3)
+                stop.set()
+                await asyncio.gather(*workers, return_exceptions=True)
+        finally:
+            mtext = gw.scheduler.metrics.render().decode()
+            await _cleanup(runners + [gw_runner])
+
+        assert inj.stats()["engine.step"]["fired"] >= 1
+        assert any(s.dead for s in sims), "no sim died"
+        # The mirror was live: at least one decode step ticked while a
+        # prefill was in flight, i.e. the kill landed under genuinely
+        # MIXED rounds, not a pure-decode fleet with inert knobs.
+        assert any(v > 0.0 for v in mixed_extras), \
+            "no mixed round observed (prefill never overlapped decode)"
+        bad = [(p, s) for p, s, *_ in results if s != 200]
+        assert not bad, f"client-visible failures: {bad[:5]}"
+        breaks = [p for p, _s, _t, _m, done in results if not done]
+        assert not breaks, f"{len(breaks)} stream break(s): {breaks[:3]}"
+        for prompt, _s, text, metas, _d in results:
+            assert verify_continuity(metas, expect_total=max_tokens) \
+                == [], prompt
+            assert text == _sim_text(sims[0], prompt, max_tokens), \
+                f"token sequence diverged for {prompt!r}"
+        assert _metric_value(
+            mtext, "llmd_tpu:stream_resume_total") >= 1.0
+        assert _metric_value(
+            mtext, 'llmd_tpu:stream_resume_total{outcome="failed"}') \
+            == 0.0
+
+    asyncio.run(asyncio.wait_for(run(), timeout=120))

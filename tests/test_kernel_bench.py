@@ -144,6 +144,31 @@ def test_kernel_bench_spec_sweep_interpret(tmp_path, capsys):
     assert doc["recommended_k"] in (1, 2)
 
 
+def test_kernel_bench_mixed_sweep_interpret(tmp_path, capsys):
+    """--mixed: the mixed-round fusion sweep times ONE streamed program
+    over the combined prefill-chunk + decode/verify population against
+    the same work as two programs (streamed chunk + decode-regime
+    kernel), through the REAL ops.moe kernel paths on the interpreter."""
+    mod = _kernel_bench()
+    out = tmp_path / "mixed.json"
+    rc = mod.main(["--mixed", "--interpret", "--t-sweep", "16,32",
+                   "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc == json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["mode"] == "mixed" and doc["timings_valid"] is False
+    assert doc["shapes"]["Qv"] == doc["shapes"]["spec_k"] + 1
+    assert [p["chunk_T"] for p in doc["points"]] == [16, 32]
+    for p in doc["points"]:
+        # Verify rows occupy K+1 slots each in the fused stream.
+        assert p["total_T"] == \
+            p["chunk_T"] + p["decode_S"] * doc["shapes"]["Qv"]
+        assert p["decode_path"] in ("dense", "routed", "streamed")
+        for prog in ("fused", "split"):
+            assert isinstance(p["ms"][prog], float) and p["ms"][prog] > 0
+            assert p["tok_s"][prog] > 0
+
+
 def test_kernel_bench_respects_path_caps(tmp_path):
     """--dense-max-t / --routed-max-t null out the capped paths (the
     shapes a real chip cannot run) and the recommendation still derives

@@ -165,6 +165,113 @@ def test_sheddable_preempted_before_critical():
         == {"crit", "std"}
 
 
+# ---------------------------------------------------------------------------
+# decode-priority chunk budgeting (round 15)
+# ---------------------------------------------------------------------------
+
+def test_decode_funded_before_prefill_when_budget_tight():
+    """With the budget smaller than a waiting prompt plus the decode's
+    token, the decode entry is funded FIRST and the prefill chunk takes
+    only what is left — a large chunk can never push a decode out of the
+    step."""
+    s = mk_sched(max_num_batched_tokens=8)
+    r1 = mk_req("r1", 4)
+    s.add_request(r1)
+    s.schedule()
+    r1.num_computed_tokens = 4
+    r1.output_token_ids.append(7)     # decoding now
+    r2 = mk_req("r2", 20)             # wants more than the whole budget
+    s.add_request(r2)
+    out = s.schedule()
+    by_id = {sr.request.request_id: sr.num_new_tokens
+             for sr in out.scheduled}
+    assert by_id == {"r1": 1, "r2": 7}
+    assert out.decode_tokens == 1 and out.prefill_tokens == 7
+    assert s.last_schedule_stats["decode_tokens"] == 1
+    assert s.last_schedule_stats["prefill_tokens"] == 7
+    assert s.last_schedule_stats["budget_left"] == 0
+
+
+def test_prefill_chunk_cap_bounds_chunks_not_decodes():
+    """An engine-installed per-chunk cap bounds every prefill chunk
+    (running continuation AND first admission) but never a decode
+    entry; the pass composition lands in last_schedule_stats."""
+    s = mk_sched(max_num_batched_tokens=64)
+    s.prefill_chunk_cap = lambda decode_tokens: 4
+    d = mk_req("d", 4)
+    s.add_request(d)
+    s.schedule()                      # first chunk: capped at 4 of 4
+    d.num_computed_tokens = 4
+    d.output_token_ids.append(1)      # decoding now
+    p = mk_req("p", 20)
+    s.add_request(p)
+    out = s.schedule()
+    by_id = {sr.request.request_id: sr.num_new_tokens
+             for sr in out.scheduled}
+    assert by_id == {"d": 1, "p": 4}  # decode uncapped, chunk capped
+    assert s.last_schedule_stats["chunk_cap"] == 4
+    p.num_computed_tokens += 4
+    out = s.schedule()                # running continuation: still capped
+    by_id = {sr.request.request_id: sr.num_new_tokens
+             for sr in out.scheduled}
+    assert by_id["p"] == 4
+
+
+def test_chunk_cap_sees_funded_decode_load():
+    """The cap callable runs AFTER decode entries are funded and receives
+    their token count (mandatory + spec drafts) — the hook an adaptive
+    policy sizes chunks against."""
+    seen = []
+    s = mk_sched(max_num_batched_tokens=64)
+    s.prefill_chunk_cap = lambda decode_tokens: seen.append(
+        decode_tokens) or None
+    for rid in ("a", "b"):
+        r = mk_req(rid, 4)
+        s.add_request(r)
+        s.schedule()
+        r.num_computed_tokens = 4
+        r.output_token_ids.append(1)
+    s.add_request(mk_req("p", 10))
+    out = s.schedule()
+    assert seen[-1] == 2              # both decodes funded before the cap
+    assert out.decode_tokens == 2 and out.prefill_tokens == 10
+
+
+def test_shrink_to_fit_conserves_budget_and_terminates():
+    """An in-flight prefill chunk that cannot fully fit (the decode
+    scheduled earlier in the pass holds blocks and is not an eligible
+    victim) shrinks to the free pool; the tokens it did NOT schedule
+    were never charged, so the budget accounting stays exact, and the
+    shrink loop terminates rather than livelocking."""
+    # 12 usable blocks of 4; budget 16 forces chunking.
+    s = mk_sched(num_blocks=13, block_size=4, max_num_batched_tokens=16)
+    d = mk_req("d", 16)
+    s.add_request(d)
+    s.schedule()
+    d.num_computed_tokens = 16
+    d.output_token_ids.append(1)      # decode: next step needs a 5th block
+    p = mk_req("p", 32)               # 8 blocks total — fits the pool,
+    s.add_request(p)                  # but not alongside d's 5 today
+    out = s.schedule()                # first chunk: 15 (decode took 1)
+    assert {sr.request.request_id: sr.num_new_tokens
+            for sr in out.scheduled} == {"d": 1, "p": 15}
+    p.num_computed_tokens += 15
+    d.num_computed_tokens += 1
+    d.output_token_ids.append(2)
+    out = s.schedule()
+    # p asks for 15 more (-> 8 blocks) but only 3 blocks are free and d
+    # (already scheduled this pass) cannot be preempted: the chunk
+    # shrinks to the 13 tokens that fit instead of stalling or thrashing.
+    by_id = {sr.request.request_id: sr.num_new_tokens
+             for sr in out.scheduled}
+    assert by_id == {"d": 1, "p": 13}
+    assert d.state == RequestState.RUNNING          # never preempted
+    assert out.decode_tokens == 1 and out.prefill_tokens == 13
+    assert out.total_tokens == 14
+    # Budget conservation: only scheduled tokens were charged.
+    assert s.last_schedule_stats["budget_left"] == 16 - 14
+
+
 def test_criticality_tier_orders_queue_admission():
     s = mk_sched(max_num_batched_tokens=8, max_num_seqs=1)
     std = mk_req("std", 4)

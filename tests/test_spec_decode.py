@@ -337,11 +337,12 @@ def test_spec_adaptive_k_backs_off_on_rejection():
     assert req.spec_drafted < 4 * 15        # not every step paid depth 4
 
 
-def test_spec_mixed_round_falls_back_and_rolls_back(plain_engine,
-                                                    spec_engine):
-    """A prefill admitted mid-decode makes the round ineligible: the
-    engine falls back to the classic path, rolls the optimistic draft
-    allocations back, and both requests finish with correct output."""
+def test_spec_mixed_round_runs_fused_with_correct_output(plain_engine,
+                                                         spec_engine):
+    """A prefill admitted mid-decode rides the SAME fused program as the
+    decode/verify rows (round 15: no classic fallback, no draft
+    rollback) — both requests finish with byte-correct output and the
+    pool is leak-free afterwards."""
     free0 = _free_blocks(spec_engine)
     a = greedy_req("ma", [1, 5, 9, 200, 3], n=14)
     b = greedy_req("mb", [4, 4, 4, 8], n=10)
