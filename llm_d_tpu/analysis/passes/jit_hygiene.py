@@ -13,11 +13,15 @@ remote chip — larger than the step it blocks).
           hazards that change numerics per call site.
   JIT003  ``jax.device_get`` / ``.item()`` in a function reachable from
           ``EngineCore.step`` (call graph over ``self.*()`` calls in
-          engine/engine.py).  The two deliberate sync points (the
-          batched token fetch, the multistep retire) carry explicit
-          ``# llmd: ignore[JIT]`` comments — any NEW host sync in the
-          decode hot loop must be argued for the same way, not land
-          silently.
+          engine/engine.py).  Sync-point inventory (round 16): on the
+          everything-on path the fused-multistep retire is THE one host
+          sync per dispatch — N engine rounds amortize a single batched
+          fetch; the fused single-round fetch, the classic multistep
+          retire and the classic per-step batched fetch are the
+          documented syncs of the narrower paths each covers.  All four
+          carry explicit ``# llmd: ignore[JIT]`` comments — any NEW
+          host sync in the decode hot loop must be argued for the same
+          way, not land silently.
 """
 
 from __future__ import annotations

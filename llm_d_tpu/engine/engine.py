@@ -183,6 +183,15 @@ class EngineConfig:
     # the drafter's real hit rate on random-init weights.  Changes model
     # output — never set on a serving path.
     spec_fixed_accept: Optional[float] = None
+    # Strict composition mode (--spec-strict / LLMD_SPEC_STRICT): a
+    # requested feature the engine would demote at STARTUP refuses to
+    # boot instead of shipping a silently degraded config behind a log
+    # line.  After round 16 the startup-blocker set is empty by design
+    # (spec composes with multistep/async, stacked dp and EPLB), so this
+    # is a regression tripwire; per-request runtime demotions
+    # (do_remote_decode rows) stay counter-only either way.  None
+    # resolves LLMD_SPEC_STRICT (default 0).
+    spec_strict: Optional[bool] = None
 
     def resolve_model(self) -> ModelConfig:
         return self.model_config or get_config(self.model)
@@ -434,6 +443,15 @@ class EngineCore:
         self.max_blocks_per_seq = -(-c.max_model_len // config.block_size)
         self._rng = jax.random.PRNGKey(config.seed)
         self._step_count = 0
+        # Device dispatches (one program launch + one host fetch each):
+        # step_count / dispatch_count is the N-round amortization ratio
+        # the everything-on acceptance test asserts (~N under fused
+        # multistep, ~1 classic).
+        self._dispatch_count = 0
+        # (feature, blocker) pairs already warned about — runtime
+        # demotions (e.g. a do_remote_decode row every schedule pass)
+        # count on every occurrence but log once.
+        self._disabled_seen: set = set()
         # PD producer: finished prefills whose blocks stay pinned until the
         # decode engine pulls them (reference contract: README.tpu.md:182-189).
         self.pinned_transfers: Dict[str, Request] = {}
@@ -483,23 +501,26 @@ class EngineCore:
         self.spec_tracker = None
         self._spec_fn = None
         self._fused_fns: Dict[Tuple[bool, bool], Any] = {}
+        # N-round fused-multistep programs, keyed like _fused_fns.
+        self._fms_fns: Dict[Tuple[bool, bool], Any] = {}
+        self.spec_strict = (bool(config.spec_strict)
+                            if config.spec_strict is not None
+                            else env_int("LLMD_SPEC_STRICT", 0) != 0)
         if spec_mode != "off" and spec_k > 0:
-            # Composition gates: spec decode owns the multi-token decode
-            # step, so the fused-multistep/async pipeline and the spec
-            # program are per-engine alternatives; stacked dp and EPLB
-            # integration are future work (the refactor they need —
-            # variable tokens-per-step through scheduler/KV/sampling —
-            # lands here either way).
-            blocker = (
-                "async_scheduling/num_scheduler_steps > 1 (the fused "
-                "decode pipeline owns multi-token steps there)"
-                if config.num_scheduler_steps > 1 else
-                "stacked SPMD dp" if self.dp > 1 else
-                "EPLB" if self.eplb is not None else None)
-            if blocker is not None:
-                logger.warning("spec decode requested (K=%d) but disabled: "
-                               "engine uses %s", spec_k, blocker)
-            else:
+            # Round 16: the composition gates are gone.  Spec decode is
+            # the body of the fused pipeline — num_scheduler_steps > 1
+            # loops the mixed round on device (_build_fused_multistep_fn),
+            # stacked dp builds per-shard verify strides, and EPLB's
+            # routed-id collection rides the fused program — so the
+            # blocker set is empty by design and everything arms
+            # together.  Any blocker that resurfaces is a regression:
+            # _disable_feature makes it a refused boot under
+            # LLMD_SPEC_STRICT=1 and a scrapeable counter otherwise.
+            blockers = self._spec_blockers()
+            for blocker in blockers:
+                self._disable_feature("spec_decode", blocker,
+                                      startup=True)
+            if not blockers:
                 from llm_d_tpu.predictor.model import SpecAcceptanceTracker
                 self.spec_k = int(spec_k)
                 self.draft_params = jax.device_put(
@@ -526,6 +547,35 @@ class EngineCore:
             self._build_multistep_fn(config.num_scheduler_steps)
             if config.num_scheduler_steps > 1 else None)
 
+    # ---------- feature-composition accounting ----------
+
+    def _spec_blockers(self) -> List[str]:
+        """Startup conditions that would force spec decode off.  Empty
+        since round 16 — the fused pipeline owns multistep/async rounds
+        with spec verify in the loop body, stacked dp carries per-shard
+        verify strides, and EPLB collects routed ids from the fused
+        program — kept as the single place a future incompatibility
+        must be declared so _disable_feature (strict mode + the
+        feature-disabled counter) governs it rather than an ad-hoc log
+        line."""
+        return []
+
+    def _disable_feature(self, feature: str, blocker: str,
+                         startup: bool = False) -> None:
+        """Account for a feature demotion: count it
+        (engine_feature_disabled_total{feature,blocker}), log it, and —
+        for STARTUP demotions under strict mode — refuse to boot rather
+        than serve a silently degraded config."""
+        self.metrics.inc_feature_disabled(feature, blocker)
+        if startup and self.spec_strict:
+            raise ValueError(
+                f"{feature} requested but unavailable ({blocker}) and "
+                f"LLMD_SPEC_STRICT/--spec-strict is set: refusing to "
+                f"start with a silently degraded config")
+        if (feature, blocker) not in self._disabled_seen:
+            self._disabled_seen.add((feature, blocker))
+            logger.warning("%s demoted: %s", feature, blocker)
+
     # ---------- jitted step ----------
 
     def _prefill_chunk_cap(self, decode_tokens: int) -> Optional[int]:
@@ -540,10 +590,14 @@ class EngineCore:
         if self._step_time_target_ms <= 0.0 \
                 or not self.step_time_model.trained:
             return None
+        # Under fused multistep the funded chunk is re-run every round of
+        # the N-round dispatch, so size it against the per-round budget.
+        rounds = (max(1, self.config.num_scheduler_steps)
+                  if self._spec_fn is not None else 1)
         return self.step_time_model.chunk_for(
             decode_tokens, self._step_time_target_ms,
             lo=self.config.min_token_bucket,
-            hi=self.config.max_num_batched_tokens)
+            hi=self.config.max_num_batched_tokens, rounds=rounds)
 
     def _moe_opts(self) -> Optional[Dict[str, Any]]:
         """MoE dispatch knobs, captured by every step program.  The model
@@ -791,6 +845,8 @@ class EngineCore:
         self._rng, step_key = jax.random.split(self._rng)
         ids_ks, self.kv_cache, routed_ks = self._multistep_fn(
             self.params, self.kv_cache, mbatch, step_key)
+        self._dispatch_count += 1
+        self.metrics.engine_dispatches.inc()
         return dict(scheduled=list(scheduled), K=K, meta=meta, rows=rows,
                     ids_dev=ids_ks, routed_dev=routed_ks,
                     t0=time.monotonic())
@@ -807,6 +863,7 @@ class EngineCore:
         ids_ks = np.asarray(jax.device_get(inflight["ids_dev"]))
         ids_ks = ids_ks.reshape(K, -1)
         self._step_count += K
+        self.metrics.engine_steps.inc(K)
         # Fused-decode step span (K engine steps in one device program),
         # stamped from the dispatch/retire clock reads that already
         # bracket the sync above — no new sync for tracing.
@@ -965,17 +1022,25 @@ class EngineCore:
     def _spec_lookahead(self, req: Request) -> int:
         """Draft tokens worth scheduling for this decode entry (the
         scheduler's spec callback): fresh drafts only, depth from the
-        acceptance tracker's adaptive K, capped so the step can neither
-        run past max_model_len nor draft beyond the request's own
-        max_tokens (those verify FLOPs could never emit)."""
+        acceptance tracker's adaptive K, capped so the DISPATCH — all
+        num_scheduler_steps fused rounds, each advancing up to k+1
+        tokens before the next host look — can neither run past
+        max_model_len nor draft beyond the request's own max_tokens
+        (those verify FLOPs could never emit).  Logprobs rows draft
+        like any other since round 16 (the fused program scores the
+        whole verify stride); only do_remote_decode rows demote, and
+        that demotion is counted."""
         sp = req.sampling
-        if sp.logprobs is not None or req.do_remote_decode:
+        if req.do_remote_decode:
+            self._disable_feature("spec_decode", "do_remote_decode")
             return 0
         if req.spec_drafts_at != req.num_tokens or not req.spec_drafts:
             return 0                      # stale or absent: plain decode
+        rounds = max(1, self.config.num_scheduler_steps)
         k = min(self.spec_tracker.suggest_k(req.request_id),
                 len(req.spec_drafts), self.spec_k)
-        k = min(k, self.model_config.max_model_len - req.num_tokens - 1)
+        k = min(k, (self.model_config.max_model_len - req.num_tokens)
+                // rounds - 1)
         k = min(k, sp.max_tokens - len(req.output_token_ids) - 1)
         return max(0, k)
 
@@ -1001,11 +1066,17 @@ class EngineCore:
         side.  The drafter proposes next-step drafts for EVERY row from
         its accepted position's hidden state — prefill-completing rows
         therefore enter their first decode step already spec-armed.
-        ``want_logprobs``/``want_top`` add the classic sampling epilogue
-        for slot-0 logits only for the rows that asked (variants cached
-        like _step_fn/_step_fn_top).  Only ids, accepted counts, drafts
-        and the optional logprob arrays travel host-ward — in the step's
-        one batched fetch, never a new sync."""
+        Round 16 composition: the same program serves the STACKED
+        [dp, S_l] layout (leading dims flattened shard-major before
+        verify, exactly like the classic step fn), collects routed
+        expert ids for EPLB when it is armed, and scores EVERY verify-
+        stride position when logprobs are wanted (verify_logprobs) —
+        the host slices the accepted prefix after the fetch, so
+        logprobs rows draft like any other and _spec_lookahead's old
+        demotion is gone.  ``want_logprobs``/``want_top`` variants are
+        cached like _step_fn/_step_fn_top.  Only ids, accepted counts,
+        drafts and the optional logprob arrays travel host-ward — in
+        the step's one batched fetch, never a new sync."""
         c = self.model_config
         block_size = self.config.block_size
         backend = self.config.attn_backend
@@ -1013,49 +1084,75 @@ class EngineCore:
         moe_opts = self._moe_opts()
         fixed = self.config.spec_fixed_accept
         Qv = K + 1
+        collect_routed = self.eplb is not None
 
         @functools.partial(jax.jit, donate_argnums=(2,))
         def fused_fn(params, draft_params, kv_cache, batch, rng):
-            hidden, kv_cache = model.forward(
-                params, kv_cache, batch, c, block_size, backend,
-                mesh=mesh, moe_opts=moe_opts)       # [S*Qv, D]
+            if collect_routed:
+                hidden, kv_cache, routed = model.forward(
+                    params, kv_cache, batch, c, block_size, backend,
+                    mesh=mesh, collect_routed=True, moe_opts=moe_opts)
+            else:
+                hidden, kv_cache = model.forward(
+                    params, kv_cache, batch, c, block_size, backend,
+                    mesh=mesh, moe_opts=moe_opts)   # [S*Qv, D]
+                routed = None
             logits = model.compute_logits(params, hidden, c)
+            if logits.ndim == 3:
+                # Stacked (SPMD dp): flatten [dp, S_l*Qv, V] ->
+                # [dp*S_l*Qv, V]; the per-row verify fields flatten the
+                # same shard-major way, so flat verify row s*Qv + q of
+                # flat sequence s = shard*S_l + i stays aligned.
+                logits = logits.reshape(-1, logits.shape[-1])
+                batch = dict(batch, draft_tokens=(
+                    batch["draft_tokens"].reshape(-1, K)), **{
+                        k: batch[k].reshape(-1)
+                        for k in ("temperature", "top_k", "top_p",
+                                  "seeds", "gen0", "spec_n")})
             ids, accepted = sampling_ops.spec_verify(
                 logits, batch["draft_tokens"], batch["spec_n"],
                 batch["temperature"], batch["top_k"], batch["top_p"],
                 rng, seeds=batch["seeds"], gen0=batch["gen0"],
                 fixed_accept=fixed, step=batch["spec_step"])
             S = accepted.shape[0]
-            h = hidden.reshape(S, Qv, hidden.shape[-1])
+            h = hidden.reshape(-1, hidden.shape[-1]).reshape(
+                S, Qv, hidden.shape[-1])
             h_a = jnp.take_along_axis(
                 h, accepted[:, None, None], axis=1)[:, 0]
             bonus = jnp.take_along_axis(ids, accepted[:, None], axis=1)[:, 0]
             drafts = model.draft_propose(
                 params, draft_params, h_a, bonus, K, c)
             logprobs = top = None
-            if want_logprobs or want_top:
-                # Classic sampling epilogue for the rows that asked, on
-                # slot-0 logits only (logprobs requests schedule with
-                # spec_n=0, so slot 0 IS their sampled token's row).
-                logits0 = logits.reshape(S, Qv, logits.shape[-1])[:, 0]
-                if want_top:
-                    logprobs, top_ids, top_lps = \
-                        sampling_ops.compute_top_logprobs(logits0, ids[:, 0])
-                    top = (top_ids, top_lps)
-                else:
-                    logprobs = sampling_ops.compute_logprobs(
-                        logits0, ids[:, 0])
-            return ids, accepted, drafts, logprobs, top, kv_cache
+            if want_top:
+                logprobs, top_ids, top_lps = sampling_ops.verify_logprobs(
+                    logits, ids, top_n=20)
+                top = (top_ids, top_lps)
+            elif want_logprobs:
+                logprobs = sampling_ops.verify_logprobs(logits, ids)
+            return ids, accepted, drafts, logprobs, top, routed, kv_cache
 
         return fused_fn
 
-    def _build_fused_batch(self, scheduled) -> Dict[str, Any]:
-        """Host arrays for a fused mixed round: the ragged chunked-prefill
-        token layout (each row packs its real length — a prefill chunk's
-        n tokens, or a decode row's last-accepted token + nd drafts) plus
-        a FIXED [S*(K+1)] verify-stride ``sample_idx`` feeding spec_verify
-        whatever the row mix is, so one compiled program per (T, S, Q)
-        bucket covers pure-prefill, pure-decode and mixed rounds alike.
+    def _empty_fused_np(self, T: int, S: int, Q: int, B: int
+                        ) -> Dict[str, np.ndarray]:
+        arrs = self._empty_batch_np(T, S, Q, B)
+        del arrs["gen_idx"]     # spec_verify consumes gen0 + verify fields
+        K = self.spec_k
+        arrs["sample_idx"] = np.zeros(S * (K + 1), np.int32)
+        arrs["gen0"] = np.zeros(S, np.int32)
+        arrs["draft_tokens"] = np.zeros((S, K), np.int32)
+        arrs["spec_n"] = np.zeros(S, np.int32)
+        return arrs
+
+    def _fill_fused_batch(self, arrs: Dict[str, np.ndarray], scheduled,
+                          block_offset: int = 0) -> None:
+        """Fill one (shard's) fused mixed-round arrays: the ragged
+        chunked-prefill token layout (each row packs its real length — a
+        prefill chunk's n tokens, or a decode row's last-accepted token
+        + nd drafts) plus a FIXED [S*(K+1)] verify-stride ``sample_idx``
+        feeding spec_verify whatever the row mix is, so one compiled
+        program per (T, S, Q) bucket covers pure-prefill, pure-decode
+        and mixed rounds alike.
 
         Per-row gather: decode row slots q map to token t0+min(q, nd)
         (its computed positions, tail replicated — consumed slots q <= nd
@@ -1063,32 +1160,13 @@ class EngineCore:
         spec_verify); prefill rows replicate the chunk's LAST token into
         all slots (slot 0 is the classic first-token sample; the rest
         feed nothing).  Padding rows gather token 0 and carry spec_n=0 /
-        temperature 0 — their samples are discarded host-side."""
+        temperature 0 — their samples are discarded host-side.
+        ``block_offset`` rebases global block ids to shard-local ones
+        (stacked mode; 0 on the single-mesh path)."""
         cfg = self.config
         K = self.spec_k
         Qv = K + 1
-        B = self.max_blocks_per_seq
         bs = cfg.block_size
-        S = _next_bucket(len(scheduled),
-                         min(cfg.min_seq_bucket, cfg.max_num_seqs),
-                         cfg.max_num_seqs)
-        total = sum(sr.num_new_tokens + sr.num_draft_tokens
-                    for sr in scheduled)
-        # Drafts are budgeted like real tokens (scheduler charges n +
-        # spec_n), so total <= max_num_batched_tokens always holds.
-        T = _next_bucket(total, cfg.min_token_bucket,
-                         cfg.max_num_batched_tokens)
-        max_q = max((sr.num_new_tokens + sr.num_draft_tokens
-                     for sr in scheduled), default=1)
-        Q = 1 if max_q == 1 else _next_bucket(
-            max_q, cfg.min_token_bucket, cfg.max_num_batched_tokens)
-        arrs = self._empty_batch_np(T, S, Q, B)
-        del arrs["gen_idx"]     # spec_verify consumes gen0 + verify fields
-        arrs["sample_idx"] = np.zeros(S * Qv, np.int32)
-        arrs["gen0"] = np.zeros(S, np.int32)
-        arrs["draft_tokens"] = np.zeros((S, K), np.int32)
-        arrs["spec_n"] = np.zeros(S, np.int32)
-        arrs["spec_step"] = np.int32(self._step_count)
         t = 0
         for s, sr in enumerate(scheduled):
             req, n = sr.request, sr.num_new_tokens
@@ -1108,7 +1186,7 @@ class EngineCore:
             arrs["positions"][t:t + n_row] = pos
             arrs["token_seq_ids"][t:t + n_row] = s
             arrs["token_qpos"][t:t + n_row] = np.arange(n_row)
-            blocks = np.asarray(req.block_ids, np.int32)
+            blocks = np.asarray(req.block_ids, np.int32) - block_offset
             arrs["slot_mapping"][t:t + n_row] = \
                 blocks[pos // bs] * bs + pos % bs
             arrs["block_tables"][s, :len(blocks)] = blocks
@@ -1128,7 +1206,77 @@ class EngineCore:
             arrs["gen0"][s] = len(req.output_token_ids)
             arrs["spec_n"][s] = nd
             t += n_row
-        return arrs
+
+    def _build_fused_batch(self, scheduled) -> Tuple[
+            Dict[str, Any], List, np.ndarray, np.ndarray, int]:
+        """Device batch for a fused mixed round, single-mesh or STACKED.
+
+        Returns (batch, scheduled_flat, rows, tok_offs, T_flat):
+        ``rows[i]`` is entry i's flat sample-row index (shard*S_l + s in
+        stacked mode) and ``tok_offs[i]`` its first flat token index —
+        what the retire loop and EPLB's accepted-aware valid mask key
+        on.  Stacked mode groups requests by KV shard like
+        _build_batch, pads every shard to common [T_l]/[S_l] buckets
+        and rebases block ids shard-locally; per-row rollback
+        (trim_request) stays shard-local because block ids on the
+        request are global and only the device copy is rebased."""
+        cfg = self.config
+        B = self.max_blocks_per_seq
+        max_q = max((sr.num_new_tokens + sr.num_draft_tokens
+                     for sr in scheduled), default=1)
+        Q = 1 if max_q == 1 else _next_bucket(
+            max_q, cfg.min_token_bucket, cfg.max_num_batched_tokens)
+
+        if self.dp == 1:
+            S = _next_bucket(len(scheduled),
+                             min(cfg.min_seq_bucket, cfg.max_num_seqs),
+                             cfg.max_num_seqs)
+            total = sum(sr.num_new_tokens + sr.num_draft_tokens
+                        for sr in scheduled)
+            # Drafts are budgeted like real tokens (scheduler charges
+            # n + spec_n), so total <= max_num_batched_tokens holds.
+            T = _next_bucket(total, cfg.min_token_bucket,
+                             cfg.max_num_batched_tokens)
+            arrs = self._empty_fused_np(T, S, Q, B)
+            self._fill_fused_batch(arrs, scheduled)
+            arrs["spec_step"] = np.int32(self._step_count)
+            batch = jax.device_put(arrs, self._replicated)
+            offs = np.cumsum([0] + [sr.num_new_tokens + sr.num_draft_tokens
+                                    for sr in scheduled[:-1]])
+            return (batch, list(scheduled), np.arange(len(scheduled)),
+                    offs.astype(np.int64), T)
+
+        per = self._split_by_shard(scheduled)
+        T_l = _next_bucket(
+            max(sum(sr.num_new_tokens + sr.num_draft_tokens
+                    for sr in shard) for shard in per),
+            cfg.min_token_bucket, cfg.max_num_batched_tokens)
+        S_l = _next_bucket(
+            max(len(shard) for shard in per),
+            min(cfg.min_seq_bucket, cfg.max_num_seqs), cfg.max_num_seqs)
+        B_l = self.kv_manager.blocks_per_region
+        shard_arrs = []
+        scheduled_flat: List = []
+        rows: List[int] = []
+        tok_offs: List[int] = []
+        for r, shard in enumerate(per):
+            arrs = self._empty_fused_np(T_l, S_l, Q, B)
+            self._fill_fused_batch(arrs, shard, block_offset=r * B_l)
+            shard_arrs.append(arrs)
+            scheduled_flat.extend(shard)
+            rows.extend(r * S_l + s for s in range(len(shard)))
+            t = 0
+            for sr in shard:
+                tok_offs.append(r * T_l + t)
+                t += sr.num_new_tokens + sr.num_draft_tokens
+        stacked_np = {k: np.stack([a[k] for a in shard_arrs])
+                      for k in shard_arrs[0]}
+        stacked_np["spec_step"] = np.int32(self._step_count)
+        batch = {k: jax.device_put(
+                     v, self._dp_sharded if np.ndim(v) else self._replicated)
+                 for k, v in stacked_np.items()}
+        return (batch, scheduled_flat, np.asarray(rows, np.int64),
+                np.asarray(tok_offs, np.int64), self.dp * T_l)
 
     def _run_fused(self, sched: SchedulerOutput) -> List[RequestOutput]:
         """One fused mixed-round engine step (ANY row mix once spec decode
@@ -1158,11 +1306,14 @@ class EngineCore:
             fn = self._build_fused_fn(self.spec_k, want_logprobs=want_lp,
                                       want_top=want_top)
             self._fused_fns[(want_lp, want_top)] = fn
-        batch = jax.device_put(self._build_fused_batch(scheduled),
-                               self._replicated)
+        batch, scheduled, rows, tok_offs, t_flat = \
+            self._build_fused_batch(scheduled)
         self._rng, step_key = jax.random.split(self._rng)
-        ids_dev, acc_dev, drafts_dev, lp_dev, top_dev, self.kv_cache = fn(
+        (ids_dev, acc_dev, drafts_dev, lp_dev, top_dev, routed_dev,
+         self.kv_cache) = fn(
             self.params, self.draft_params, self.kv_cache, batch, step_key)
+        self._dispatch_count += 1
+        self.metrics.engine_dispatches.inc()
         # ONE batched fetch, exactly like the classic step's: ids +
         # accepted counts + next drafts (+ optional logprob arrays) in a
         # single tunnel round trip.
@@ -1178,11 +1329,31 @@ class EngineCore:
         top = (np.asarray(fetched[-2]), np.asarray(fetched[-1])) \
             if top_dev is not None else None
         self._step_count += 1
+        self.metrics.engine_steps.inc()
+        if self.eplb is not None and routed_dev is not None:
+            # Accepted-aware valid-token mask: a decode row's verify
+            # stride keeps its accepted prefix (+ the bonus slot) only —
+            # rejected drafts' routing must not skew the balance stats,
+            # exactly as their KV is trimmed — and prefill rows keep
+            # their real chunk tokens; shard pad tokens stay masked.
+            valid = np.zeros(t_flat, bool)
+            for i, sr in enumerate(scheduled):
+                off = int(tok_offs[i])
+                if sr.num_draft_tokens:
+                    a = min(int(accepted[int(rows[i])]),
+                            sr.num_draft_tokens)
+                    valid[off:off + a + 1] = True
+                else:
+                    valid[off:off + sr.num_new_tokens] = True
+            self.params = self.eplb.on_step(
+                routed_dev[:, valid, :], self._step_count,
+                self.params, self.mesh)
 
         outputs: List[RequestOutput] = []
         now = time.monotonic()
         total_drafted = total_accepted = 0
-        for s, sr in enumerate(scheduled):
+        for i, sr in enumerate(scheduled):
+            s = int(rows[i])
             req, n = sr.request, sr.num_new_tokens
             nd = sr.num_draft_tokens
             # A TRUE decode entry has sampled at least one output token:
@@ -1245,13 +1416,13 @@ class EngineCore:
                 top_lp = None
                 if (req.sampling.logprobs or 0) > 0 and top is not None:
                     n_top = min(int(req.sampling.logprobs),
-                                top[0].shape[1])
-                    top_lp = [{int(top[0][s, j]): float(top[1][s, j])
+                                top[0].shape[-1])
+                    top_lp = [{int(top[0][s, 0, j]): float(top[1][s, 0, j])
                                for j in range(n_top)}]
                 outputs.append(RequestOutput(
                     req.request_id, [token], finish is not None,
                     finish_reason=finish,
-                    logprobs=([float(logprobs[s])]
+                    logprobs=([float(logprobs[s, 0])]
                               if req.sampling.logprobs is not None
                               else None),
                     top_logprobs=top_lp))
@@ -1307,18 +1478,22 @@ class EngineCore:
             req.spec_drafts = [int(tk) for tk in drafts[s]]
             req.spec_drafts_at = req.num_tokens
             self.kv_manager.cache_full_blocks(req)
-            # Top-N alternatives: a logprobs>0 row never drafts
-            # (_spec_lookahead), so it emits exactly slot 0's token and
-            # the slot-0 top arrays are its alternatives.
+            # Per-position logprobs over the verify stride (round 16):
+            # a drafting row emits its accepted prefix's logprobs — one
+            # float (and one top-N dict) per emitted token — sliced from
+            # the [S, K+1] stride arrays the fused program scored; the
+            # rejected tail is simply never read.
             top_lp = None
             if (req.sampling.logprobs or 0) > 0 and top is not None:
-                n_top = min(int(req.sampling.logprobs), top[0].shape[1])
-                top_lp = [{int(top[0][s, j]): float(top[1][s, j])
-                           for j in range(n_top)}]
+                n_top = min(int(req.sampling.logprobs), top[0].shape[-1])
+                top_lp = [{int(top[0][s, q, j]): float(top[1][s, q, j])
+                           for j in range(n_top)}
+                          for q in range(len(new_tokens))]
             outputs.append(RequestOutput(
                 req.request_id, new_tokens, finish is not None,
                 finish_reason=finish,
-                logprobs=([float(logprobs[s])]
+                logprobs=([float(logprobs[s, q])
+                           for q in range(len(new_tokens))]
                           if logprobs is not None
                           and req.sampling.logprobs is not None
                           else None),
@@ -1368,6 +1543,710 @@ class EngineCore:
                 drafted=total_drafted, accepted=total_accepted)
         self._update_queue_metrics()
         return outputs
+
+    # ---------- fused multistep (N mixed rounds per dispatch) ----------
+
+    def _build_fused_multistep_fn(self, want_logprobs: bool = False,
+                                  want_top: bool = False):
+        """N fused mixed rounds as ONE device program (a ``lax.scan``
+        over the PR 15 mixed round): spec draft state, the per-row
+        position (the KV write/rollback head), sampling continuity
+        (gen0, per-round fold keys) and chunk progress all carry ON
+        DEVICE between rounds, so the engine pays one dispatch and one
+        host fetch per N rounds instead of per step (NanoFlow-style:
+        keep the resident program fed rather than the host in the
+        loop).
+
+        Row layout is the fused round's [S] (or stacked [dp, S_l]) with
+        a FIXED per-row token stride for all N rounds: a decode row's
+        stride is 1+nd (last accepted token + nd draft slots); a
+        prefill row's is its round-0 chunk size — later rounds reuse
+        the same slots for the next chunk, and once the prompt
+        completes the row's remaining rounds run as decode with up to
+        min(K, stride-1) drafts in the same slots (unused slots write
+        block-0 trash, the multistep pad idiom).  Everything host-
+        knowable is precomputed into ``xs`` [N, ...] (chunk tokens /
+        positions / slots, verify sample_idx, per-round spec_n and role
+        flags); the device patches only what depends on sampled state —
+        decode rows' token ids (carried last token + drafts), their
+        positions/slots (from the pos carry) and seq_lens.  KV rollback
+        is implicit: a rejected draft's slot is overwritten by the next
+        round's write at the same position (slot = f(position) through
+        the unchanged block table) and never attended (seq_lens masks
+        it); the host reconciles the block list with ONE trim_request
+        per row at retire.
+
+        Returns per-round ids/accepted (+ optional verify-stride
+        logprobs, + routed ids under EPLB) and the final carry — an
+        async successor dispatch starts from the carry without any
+        host fetch."""
+        c = self.model_config
+        block_size = self.config.block_size
+        backend = self.config.attn_backend
+        model, mesh = self.model, self.mesh
+        moe_opts = self._moe_opts()
+        fixed = self.config.spec_fixed_accept
+        K = self.spec_k
+        Qv = K + 1
+        collect_routed = self.eplb is not None
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def fms_fn(params, draft_params, kv_cache, carry0, sbatch, xs, rng):
+            stacked = sbatch["temperature"].ndim == 2
+            bt = sbatch["block_tables"]
+            slot_row = sbatch["slot_row"]     # [.., T_l] LOCAL row per token
+            slot_q = sbatch["slot_q"]         # [.., T_l] slot within stride
+            active = sbatch["active"]
+
+            def fr(a):    # flatten rows/tokens: [dp, X, ...] -> [dp*X, ...]
+                return a.reshape((-1,) + a.shape[2:]) if stacked else a
+
+            def ur(a, like):    # restore stacked leading dims
+                return (a.reshape(like.shape[:2] + a.shape[1:])
+                        if stacked else a)
+
+            def one_round(carry, per_round):
+                kv_cache, pos, last, drafts, gen0 = carry
+                key, x = per_round
+                nd = x["spec_n"]
+                is_dec = x["is_dec"]
+                # Token-level patch: decode rows' content depends on
+                # sampled carry; prefill chunks came precomputed in xs.
+                # All gathers are along the LOCAL row axis (axis=-1 /
+                # -2), so stacked shards never index across each other.
+                patch = jnp.take_along_axis(is_dec, slot_row, axis=-1)
+                act_t = jnp.take_along_axis(active, slot_row, axis=-1)
+                nd_t = jnp.take_along_axis(nd, slot_row, axis=-1)
+                last_t = jnp.take_along_axis(last, slot_row, axis=-1)
+                drow = jnp.take_along_axis(
+                    drafts, slot_row[..., None], axis=-2)  # [.., T_l, K]
+                qi = jnp.clip(slot_q - 1, 0, max(K - 1, 0))
+                draft_t = jnp.take_along_axis(
+                    drow, qi[..., None], axis=-1)[..., 0]
+                tok_dec = jnp.where(slot_q == 0, last_t, draft_t)
+                pos_row = jnp.take_along_axis(pos, slot_row, axis=-1)
+                pos_t = jnp.where(patch, pos_row + slot_q, x["positions"])
+                dead = x["dead"] | (patch & (slot_q > nd_t)) | ~act_t
+                rowbt = jnp.take_along_axis(
+                    bt, slot_row[..., None], axis=-2)      # [.., T_l, B]
+                blk = jnp.take_along_axis(
+                    rowbt, (pos_t // block_size)[..., None],
+                    axis=-1)[..., 0]
+                slot = blk * block_size + pos_t % block_size
+                slot_mapping = jnp.where(
+                    dead, pos_t % block_size,   # block-0 trash writes
+                    jnp.where(patch, slot, x["slot_mapping"]))
+                seq_lens = jnp.where(is_dec, pos + nd + 1, x["seq_lens"])
+                seq_lens = jnp.where(active, seq_lens, 0)
+                batch = dict(
+                    token_ids=jnp.where(patch, tok_dec, x["token_ids"]),
+                    positions=pos_t, token_seq_ids=slot_row,
+                    token_qpos=slot_q, slot_mapping=slot_mapping,
+                    block_tables=bt, seq_lens=seq_lens,
+                    sample_idx=x["sample_idx"], qtok_idx=x["qtok_idx"])
+                if collect_routed:
+                    hidden, kv_cache, routed = model.forward(
+                        params, kv_cache, batch, c, block_size, backend,
+                        mesh=mesh, collect_routed=True, moe_opts=moe_opts)
+                else:
+                    hidden, kv_cache = model.forward(
+                        params, kv_cache, batch, c, block_size, backend,
+                        mesh=mesh, moe_opts=moe_opts)
+                    routed = None
+                logits = model.compute_logits(params, hidden, c)
+                if logits.ndim == 3:
+                    logits = logits.reshape(-1, logits.shape[-1])
+                ids, accepted = sampling_ops.spec_verify(
+                    logits, fr(drafts), fr(nd),
+                    fr(sbatch["temperature"]), fr(sbatch["top_k"]),
+                    fr(sbatch["top_p"]), key, seeds=fr(sbatch["seeds"]),
+                    gen0=fr(gen0), fixed_accept=fixed,
+                    step=x["spec_step"])
+                S = accepted.shape[0]
+                h = hidden.reshape(-1, hidden.shape[-1]).reshape(
+                    S, Qv, hidden.shape[-1])
+                h_a = jnp.take_along_axis(
+                    h, accepted[:, None, None], axis=1)[:, 0]
+                bonus = jnp.take_along_axis(
+                    ids, accepted[:, None], axis=1)[:, 0]
+                new_drafts = model.draft_propose(
+                    params, draft_params, h_a, bonus, K, c)
+                # Row-state update (flat rows): a decode row advances by
+                # its accepted prefix + bonus; a completing prefill row
+                # emits its first token and enters decode spec-armed
+                # (fresh device drafts); a mid-prompt row just moves its
+                # chunk pointer; inactive rows hold state.
+                is_dec_f, comp_f = fr(is_dec), fr(x["completing"])
+                act_f = fr(active)
+                emitted = jnp.where(
+                    act_f & is_dec_f, accepted + 1,
+                    jnp.where(act_f & comp_f, 1, 0))
+                sampled = act_f & (is_dec_f | comp_f)
+                tok_at = jnp.where(is_dec_f, accepted, 0)
+                last_new = jnp.where(
+                    sampled,
+                    jnp.take_along_axis(ids, tok_at[:, None], axis=1)[:, 0],
+                    fr(last))
+                drafts_new = jnp.where(
+                    sampled[:, None], new_drafts, fr(drafts))
+                gen0_new = fr(gen0) + emitted
+                pos_new = jnp.where(
+                    act_f & is_dec_f, fr(pos) + emitted,
+                    jnp.where(act_f, fr(x["next_pos"]), fr(pos)))
+                carry = (kv_cache, ur(pos_new, pos), ur(last_new, last),
+                         ur(drafts_new, drafts), ur(gen0_new, gen0))
+                ys = dict(ids=ids, accepted=accepted)
+                if want_top:
+                    lp, t_ids, t_lps = sampling_ops.verify_logprobs(
+                        logits, ids, top_n=20)
+                    ys.update(lp=lp, top_ids=t_ids, top_lps=t_lps)
+                elif want_logprobs:
+                    ys["lp"] = sampling_ops.verify_logprobs(logits, ids)
+                if collect_routed:
+                    ys["routed"] = routed
+                return carry, ys
+
+            N = xs["spec_n"].shape[0]
+            keys = jax.random.split(rng, N)
+            carry0_full = (kv_cache, carry0["pos"], carry0["last"],
+                           carry0["drafts"], carry0["gen0"])
+            (kv_cache, pos_f, last_f, drafts_f, gen0_f), ys = jax.lax.scan(
+                one_round, carry0_full, (keys, xs))
+            carry_out = dict(pos=pos_f, last=last_f, drafts=drafts_f,
+                             gen0=gen0_f)
+            return ys, carry_out, kv_cache
+
+        return fms_fn
+
+    def _fms_plan(self, sched: SchedulerOutput) -> Optional[Dict[str, Any]]:
+        """Plan an N-round fused dispatch from one schedule pass, or None
+        to fall back to a single fused round.
+
+        Per row: a decode entry runs N draft-verify rounds at its funded
+        depth (stride 1+nd — _spec_lookahead already divided the
+        max_model_len headroom by N); a prefill entry consumes its
+        prompt in stride-sized chunks (round 0's chunk IS the
+        scheduler-funded one, so the decode-priority chunk cap extends
+        across all N rounds at the same per-round load) and, once
+        complete, continues as decode with up to min(K, stride-1)
+        drafts in the same token slots.  The worst-case KV tail (every
+        draft accepted every round) is pre-allocated here — shard-local
+        under stacked dp, since block ids live globally on the request
+        — and reconciled by ONE trim_request per row at retire.  A row
+        that cannot be covered (do_remote_decode, a max_model_len
+        horizon, pool pressure) bails the whole plan, counted via
+        engine_feature_disabled_total, rather than being demoted
+        silently."""
+        N = self.config.num_scheduler_steps
+        scheduled = sched.scheduled
+        if N <= 1 or not scheduled:
+            return None
+        K = self.spec_k
+        max_len = self.model_config.max_model_len
+        specs: List[Dict[str, Any]] = []
+        for sr in scheduled:
+            req, n = sr.request, sr.num_new_tokens
+            nd = sr.num_draft_tokens
+            if req.do_remote_decode:
+                self._disable_feature("fused_multistep", "do_remote_decode")
+                return None
+            is_decode = (n == 1 and bool(req.output_token_ids)
+                         and req.num_computed_tokens == req.num_tokens - 1)
+            computed = req.num_computed_tokens
+            rounds: List[Tuple[str, int]] = []
+            if is_decode:
+                stride = 1 + nd
+                rounds = [("dec", nd)] * N
+                cover = computed + N * stride
+                min_emit = N
+            else:
+                stride = max(n, 1)
+                nd_post = min(K, stride - 1)
+                cover = computed
+                min_emit = 0
+                done = computed
+                for _ in range(N):
+                    left = req.num_tokens - done
+                    if left > 0:
+                        c_r = min(stride, left)
+                        rounds.append(("chunk", c_r))
+                        done += c_r
+                        if done == req.num_tokens:
+                            min_emit += 1       # completion emits 1
+                        cover = max(cover, done)
+                    else:
+                        rounds.append(("dec", nd_post))
+                        cover = max(cover, done + nd_post + 1)
+                        done += nd_post + 1
+                        min_emit += 1
+            if cover > max_len:
+                self._disable_feature("fused_multistep", "max_model_len")
+                return None
+            specs.append(dict(req=req, active=True, stride=stride,
+                              rounds=rounds, cover=cover,
+                              min_emit=min_emit,
+                              gen0=len(req.output_token_ids)))
+        allocated: List[Tuple[Request, Any]] = []
+        for spec in specs:
+            got = self.kv_manager.allocate(spec["req"], spec["cover"])
+            if got is None:
+                for r_, blocks in reversed(allocated):
+                    self.kv_manager.release_tail(r_, blocks)
+                self._disable_feature("fused_multistep", "kv_allocation")
+                return None
+            allocated.append((spec["req"], got))
+        if self.dp > 1:
+            shards: List[List] = [[] for _ in range(self.dp)]
+            for spec in specs:
+                shards[self.kv_manager.region_of_request(
+                    spec["req"])].append(spec)
+        else:
+            shards = [specs]
+        return self._fms_build(shards, N, self._step_count)
+
+    def _fms_build(self, shards: List[List], N: int, step_base: int,
+                   S_l: Optional[int] = None) -> Dict[str, Any]:
+        """Host arrays for an N-round fused dispatch: per-row statics
+        (sbatch — sampling params, block tables, the fixed slot_row/
+        slot_q token layout), per-round precomputed content (xs,
+        leading dim N) and the initial carry.  ``shards`` are per-KV-
+        shard spec lists in row order; inactive specs hold their row
+        slot (carry shapes are positional — a successor dispatch must
+        keep the predecessor's row assignment) but contribute no
+        tokens.  ``S_l`` pins the row bucket for successor dispatches
+        whose carry rides over on device."""
+        cfg = self.config
+        K = self.spec_k
+        Qv = K + 1
+        B = self.max_blocks_per_seq
+        bs = cfg.block_size
+        dp = self.dp
+        B_l = self.kv_manager.blocks_per_region if dp > 1 else 0
+        if S_l is None:
+            S_l = _next_bucket(max(len(sh) for sh in shards),
+                               min(cfg.min_seq_bucket, cfg.max_num_seqs),
+                               cfg.max_num_seqs)
+        T_l = _next_bucket(
+            max(sum(sp_["stride"] for sp_ in sh if sp_["active"])
+                for sh in shards) or cfg.min_token_bucket,
+            cfg.min_token_bucket, cfg.max_num_batched_tokens)
+        max_q = max((sp_["stride"] for sh in shards for sp_ in sh
+                     if sp_["active"]), default=1)
+        Q = 1 if max_q == 1 else _next_bucket(
+            max_q, cfg.min_token_bucket, cfg.max_num_batched_tokens)
+
+        sb_shards, xs_shards, carry_shards = [], [], []
+        specs_flat: List[Dict[str, Any]] = []
+        rows: List[int] = []
+        offs: List[int] = []
+        for r, shard in enumerate(shards):
+            sb = dict(
+                temperature=np.zeros(S_l, np.float32),
+                top_k=np.zeros(S_l, np.int32),
+                top_p=np.ones(S_l, np.float32),
+                seeds=np.full(S_l, -1, np.int32),
+                block_tables=np.zeros((S_l, B), np.int32),
+                active=np.zeros(S_l, bool),
+                slot_row=np.zeros(T_l, np.int32),
+                slot_q=np.zeros(T_l, np.int32))
+            x = dict(
+                token_ids=np.zeros((N, T_l), np.int32),
+                positions=np.zeros((N, T_l), np.int32),
+                slot_mapping=np.zeros((N, T_l), np.int32),
+                dead=np.ones((N, T_l), bool),
+                seq_lens=np.zeros((N, S_l), np.int32),
+                sample_idx=np.zeros((N, S_l * Qv), np.int32),
+                qtok_idx=np.full((N, S_l, Q), T_l, np.int32),
+                spec_n=np.zeros((N, S_l), np.int32),
+                is_dec=np.zeros((N, S_l), bool),
+                completing=np.zeros((N, S_l), bool),
+                next_pos=np.zeros((N, S_l), np.int32))
+            cr = dict(pos=np.zeros(S_l, np.int32),
+                      last=np.zeros(S_l, np.int32),
+                      drafts=np.zeros((S_l, K), np.int32),
+                      gen0=np.zeros(S_l, np.int32))
+            t = 0
+            for i, sp_ in enumerate(shard):
+                specs_flat.append(sp_)
+                rows.append(r * S_l + i)
+                offs.append(r * T_l + t)
+                if not sp_["active"]:
+                    continue
+                req = sp_["req"]
+                stride = sp_["stride"]
+                sampling = req.sampling
+                sb["temperature"][i] = sampling.temperature
+                sb["top_k"][i] = sampling.top_k
+                sb["top_p"][i] = sampling.top_p
+                if sampling.seed is not None:
+                    sb["seeds"][i] = int(sampling.seed) & 0x7FFFFFFF
+                blocks = np.asarray(req.block_ids, np.int32) - r * B_l
+                sb["block_tables"][i, :len(blocks)] = blocks
+                sb["active"][i] = True
+                sb["slot_row"][t:t + stride] = i
+                sb["slot_q"][t:t + stride] = np.arange(stride)
+                cr["pos"][i] = req.num_computed_tokens
+                cr["gen0"][i] = len(req.output_token_ids)
+                done = req.num_computed_tokens
+                if sp_["rounds"][0][0] == "dec" and req.output_token_ids:
+                    cr["last"][i] = req.all_token_ids[done]
+                    d = req.spec_drafts[:K]
+                    cr["drafts"][i, :len(d)] = d
+                for rno, (kind, val) in enumerate(sp_["rounds"]):
+                    if kind == "chunk":
+                        c_r = val
+                        pos = np.arange(done, done + c_r)
+                        x["token_ids"][rno, t:t + c_r] = \
+                            req.all_token_ids[done:done + c_r]
+                        x["positions"][rno, t:t + c_r] = pos
+                        x["slot_mapping"][rno, t:t + c_r] = \
+                            blocks[pos // bs] * bs + pos % bs
+                        x["dead"][rno, t:t + c_r] = False
+                        x["seq_lens"][rno, i] = done + c_r
+                        x["sample_idx"][rno, i * Qv:(i + 1) * Qv] = \
+                            t + c_r - 1
+                        x["qtok_idx"][rno, i, :c_r] = np.arange(t, t + c_r)
+                        done += c_r
+                        if done == req.num_tokens:
+                            x["completing"][rno, i] = True
+                        x["next_pos"][rno, i] = done
+                    else:
+                        nd = val
+                        used = nd + 1
+                        x["dead"][rno, t:t + used] = False
+                        x["is_dec"][rno, i] = True
+                        x["spec_n"][rno, i] = nd
+                        x["sample_idx"][rno, i * Qv:(i + 1) * Qv] = \
+                            t + np.minimum(np.arange(Qv), nd)
+                        x["qtok_idx"][rno, i, :used] = \
+                            np.arange(t, t + used)
+                t += stride
+            sb_shards.append(sb)
+            xs_shards.append(x)
+            carry_shards.append(cr)
+        if dp == 1:
+            sbatch, xs, carry = sb_shards[0], xs_shards[0], carry_shards[0]
+        else:
+            sbatch = {k: np.stack([sh[k] for sh in sb_shards])
+                      for k in sb_shards[0]}
+            xs = {k: np.stack([sh[k] for sh in xs_shards], axis=1)
+                  for k in xs_shards[0]}
+            carry = {k: np.stack([sh[k] for sh in carry_shards])
+                     for k in carry_shards[0]}
+        xs["spec_step"] = (step_base + np.arange(N)).astype(np.int32)
+        return dict(
+            kind="fms", N=N, S_l=S_l, T_flat=dp * T_l,
+            specs=specs_flat, rows=np.asarray(rows, np.int64),
+            offs=np.asarray(offs, np.int64),
+            sbatch=sbatch, xs=xs, carry=carry,
+            covers={sp_["req"].request_id: sp_["cover"]
+                    for sp_ in specs_flat if sp_["active"]})
+
+    def _fms_dispatch(self, plan: Dict[str, Any],
+                      carry_dev: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        """Launch one N-round fused dispatch; returns the in-flight
+        record WITHOUT synchronizing (per-round ids stay on device
+        until retire).  ``carry_dev`` chains a successor straight from
+        the predecessor's device carry (async double-buffering)."""
+        live = [sp_ for sp_ in plan["specs"] if sp_["active"]]
+        want_top = any((sp_["req"].sampling.logprobs or 0) > 0
+                       for sp_ in live)
+        want_lp = any(sp_["req"].sampling.logprobs is not None
+                      for sp_ in live)
+        fn = self._fms_fns.get((want_lp, want_top))
+        if fn is None:
+            fn = self._build_fused_multistep_fn(
+                want_logprobs=want_lp, want_top=want_top)
+            self._fms_fns[(want_lp, want_top)] = fn
+        if self.dp > 1:
+            xsh = NamedSharding(self.mesh, P(None, "dp"))
+            sbatch = {k: jax.device_put(v, self._dp_sharded)
+                      for k, v in plan["sbatch"].items()}
+            xs = {k: jax.device_put(
+                      v, xsh if np.ndim(v) >= 2 else self._replicated)
+                  for k, v in plan["xs"].items()}
+            carry0 = (carry_dev if carry_dev is not None
+                      else jax.device_put(plan["carry"], self._dp_sharded))
+        else:
+            sbatch = jax.device_put(plan["sbatch"], self._replicated)
+            xs = jax.device_put(plan["xs"], self._replicated)
+            carry0 = (carry_dev if carry_dev is not None
+                      else jax.device_put(plan["carry"], self._replicated))
+        self._rng, step_key = jax.random.split(self._rng)
+        ys, carry_out, self.kv_cache = fn(
+            self.params, self.draft_params, self.kv_cache, carry0,
+            sbatch, xs, step_key)
+        self._dispatch_count += 1
+        self.metrics.engine_dispatches.inc()
+        return dict(kind="fms", plan=plan, ys=ys, carry=carry_out,
+                    want_lp=want_lp, want_top=want_top,
+                    t0=time.monotonic())
+
+    def _fms_retire(self, rec: Dict[str, Any],
+                    successor: Optional[Dict[str, Any]] = None
+                    ) -> List[RequestOutput]:
+        """Synchronize one in-flight N-round dispatch and replay its
+        rounds through the per-request bookkeeping — THE one documented
+        host sync per dispatch (N engine steps amortize it).  Mirrors
+        _run_fused's per-row logic round by round: chunk rounds advance
+        prefill (completion does the classic first-token bookkeeping),
+        decode rounds walk the accepted prefix with _check_stop;
+        everything computed past a stop is a zombie and is discarded,
+        exactly like the classic multistep retire."""
+        plan = rec["plan"]
+        N = plan["N"]
+        ys = rec["ys"]
+        K = self.spec_k
+        fetch = [ys["ids"], ys["accepted"], rec["carry"]["drafts"]]
+        if rec["want_lp"] or rec["want_top"]:
+            fetch.append(ys["lp"])
+        if rec["want_top"]:
+            fetch += [ys["top_ids"], ys["top_lps"]]
+        # llmd: ignore[JIT] the one intended fused-multistep retire host sync
+        fetched = jax.device_get(fetch)
+        ids = np.asarray(fetched[0])          # [N, S_flat, K+1]
+        acc = np.asarray(fetched[1])          # [N, S_flat]
+        drafts_f = np.asarray(fetched[2]).reshape(-1, K)
+        lp = (np.asarray(fetched[3])
+              if rec["want_lp"] or rec["want_top"] else None)
+        top = ((np.asarray(fetched[-2]), np.asarray(fetched[-1]))
+               if rec["want_top"] else None)
+        self._step_count += N
+        self.metrics.engine_steps.inc(N)
+
+        outputs: List[RequestOutput] = []
+        now = time.monotonic()
+        total_drafted = total_accepted = 0
+        pre_toks = dec_toks = 0
+        valid = (np.zeros((N, plan["T_flat"]), bool)
+                 if self.eplb is not None and "routed" in ys else None)
+        for sp_, row, off in zip(plan["specs"], plan["rows"],
+                                 plan["offs"]):
+            if not sp_["active"]:
+                continue
+            req = sp_["req"]
+            s, off = int(row), int(off)
+            # The device computed every round for this row whatever the
+            # verifier kept or where a stop lands — charge it all.
+            self._account_collective_bytes(
+                sum(v if k == "chunk" else v + 1
+                    for k, v in sp_["rounds"]))
+            pre_toks += sum(v for k, v in sp_["rounds"] if k == "chunk")
+            dec_toks += sum(v + 1 for k, v in sp_["rounds"] if k == "dec")
+            if req.state is not RequestState.RUNNING:
+                continue    # zombie: finished in an earlier retire
+            new_tokens: List[int] = []
+            lp_list: List[float] = []
+            top_at: List[Tuple[int, int]] = []
+            finish = None
+            for rno, (kind, val) in enumerate(sp_["rounds"]):
+                if finish is not None:
+                    break
+                if kind == "chunk":
+                    req.num_computed_tokens += val
+                    if valid is not None:
+                        valid[rno, off:off + val] = True
+                    if req.num_computed_tokens != req.num_tokens:
+                        continue          # mid-prompt round
+                    if req.num_computed_tokens <= req.num_prompt_tokens:
+                        # Prefill just completed.
+                        self.metrics.prompt_tokens.inc(
+                            req.num_prompt_tokens)
+                        if req.num_cached_prompt_tokens:
+                            self.metrics.prefix_cache_hits.inc(
+                                req.num_cached_prompt_tokens)
+                        self.metrics.prefix_cache_queries.inc(
+                            req.num_prompt_tokens)
+                        if req.first_token_time is None:
+                            req.first_token_time = now
+                            self.metrics.time_to_first_token.observe(
+                                now - req.arrival_time)
+                            self._trace_phase(
+                                req, "engine.prefill",
+                                "first_decode" if req.do_remote_prefill
+                                else "prefill",
+                                req.first_schedule_time
+                                or req.arrival_time, now,
+                                cached_tokens=req.num_cached_prompt_tokens
+                                or None,
+                                resume_offset=req.resume_offset or None,
+                                restored_tokens=req.resume_restored_tokens
+                                or None)
+                    token = int(ids[rno, s, 0])
+                    req.output_token_ids.append(token)
+                    new_tokens.append(token)
+                    if lp is not None:
+                        lp_list.append(float(lp[rno, s, 0]))
+                    top_at.append((rno, 0))
+                    finish = self._check_stop(req, token)
+                else:
+                    nd = val
+                    a = min(int(acc[rno, s]), nd)
+                    if valid is not None:
+                        # Accepted prefix + bonus slot only: rejected
+                        # drafts' routing must not skew EPLB's balance
+                        # stats, exactly as their KV is trimmed.
+                        valid[rno, off:off + a + 1] = True
+                    total_drafted += nd
+                    total_accepted += a
+                    req.spec_drafted += nd
+                    req.spec_accepted += a
+                    if nd:
+                        self.metrics.spec_draft_tokens.inc(nd)
+                        if a:
+                            self.metrics.spec_accepted_tokens.inc(a)
+                        self.spec_tracker.observe(req.request_id, nd, a)
+                    for q in range(a + 1):
+                        token = int(ids[rno, s, q])
+                        req.num_computed_tokens += 1
+                        req.output_token_ids.append(token)
+                        new_tokens.append(token)
+                        if lp is not None:
+                            lp_list.append(float(lp[rno, s, q]))
+                        top_at.append((rno, q))
+                        finish = self._check_stop(req, token)
+                        if finish is not None:
+                            break
+            self.metrics.generation_tokens.inc(len(new_tokens))
+            if new_tokens:
+                if req.last_token_time is not None:
+                    self.metrics.inter_token_latency.observe(
+                        (now - req.last_token_time) / len(new_tokens))
+                req.last_token_time = now
+            # Next dispatch's drafts come from the FINAL carry.
+            req.spec_drafts = [int(tk) for tk in drafts_f[s]]
+            req.spec_drafts_at = req.num_tokens
+            self.kv_manager.cache_full_blocks(req)
+            sampling = req.sampling
+            top_lp = None
+            if (sampling.logprobs or 0) > 0 and top is not None:
+                n_top = min(int(sampling.logprobs), top[0].shape[-1])
+                top_lp = [{int(top[0][rno, s, q, j]):
+                           float(top[1][rno, s, q, j])
+                           for j in range(n_top)}
+                          for rno, q in top_at]
+            if new_tokens:
+                outputs.append(RequestOutput(
+                    req.request_id, new_tokens, finish is not None,
+                    finish_reason=finish,
+                    logprobs=(lp_list if lp is not None
+                              and sampling.logprobs is not None
+                              else None),
+                    top_logprobs=top_lp))
+            if finish is not None:
+                self.scheduler.finish(req, RequestState(finish))
+                self._spec_forget(req.request_id)
+                self.metrics.request_success.labels(
+                    model_name=self.metrics.model_name,
+                    finished_reason=finish).inc()
+                self.metrics.e2e_request_latency.observe(
+                    now - req.arrival_time)
+                self._trace_phase(
+                    req, "engine.decode", "decode",
+                    req.first_token_time or now, now,
+                    n_tokens=len(req.output_token_ids), finish=finish)
+            else:
+                # ONE rollback per dispatch: trim to the surviving
+                # content — or, with a successor already in flight, to
+                # ITS worst-case cover (its writes land in blocks
+                # allocated past this dispatch's tail).
+                keep = req.num_tokens
+                if successor is not None:
+                    keep = max(keep, successor["plan"]["covers"].get(
+                        req.request_id, keep))
+                self.kv_manager.trim_request(req, keep)
+        if valid is not None:
+            routed = jnp.concatenate(
+                [ys["routed"][rno][:, np.flatnonzero(valid[rno]), :]
+                 for rno in range(N)], axis=1)
+            self.params = self.eplb.on_step(
+                routed, self._step_count, self.params, self.mesh)
+        if pre_toks:
+            self.metrics.step_prefill_tokens.inc(pre_toks)
+        if dec_toks:
+            self.metrics.step_decode_tokens.inc(dec_toks)
+        # Amortized per-round sample: pairs with chunk_for(rounds=N) so
+        # LLMD_PREFILL_CHUNK=auto sizes chunks against the per-round
+        # budget, not the whole dispatch's wall time.
+        self.step_time_model.observe(
+            pre_toks / N, dec_toks / N, (now - rec["t0"]) * 1e3 / N)
+        traced = next(
+            (sp_["req"] for sp_ in plan["specs"]
+             if sp_["active"] and sp_["req"].trace_ctx is not None), None)
+        if traced is not None:
+            self.tracer.record_span(
+                "engine.step", self._mono_to_epoch(rec["t0"]),
+                self._mono_to_epoch(now), parent=traced.trace_ctx,
+                step=self._step_count,
+                kind=("decode" if pre_toks == 0
+                      else "prefill" if dec_toks == 0 else "mixed"),
+                spec=True, fused=N,
+                n_seqs=sum(1 for sp_ in plan["specs"] if sp_["active"]),
+                prefill_tokens=pre_toks, decode_tokens=dec_toks,
+                drafted=total_drafted, accepted=total_accepted)
+        self._update_queue_metrics()
+        return outputs
+
+    def _fms_try_extend(self, rec: Dict[str, Any]
+                        ) -> Optional[Dict[str, Any]]:
+        """Dispatch the in-flight N-round block's successor straight
+        from its device carry (pos/last/drafts/gen0 never visit the
+        host) — _ms_try_extend's double-buffering contract applied to
+        the fused pipeline.  Successors are pure-decode; a row still
+        mid-prompt, new arrivals, rejections, expired deadlines, pool
+        pressure or a max_model_len horizon all drain the pipeline so
+        the next step's schedule() pass re-plans."""
+        if self._rejected or self.scheduler.waiting:
+            return None
+        if self.kv_connector is not None and self.kv_connector.has_pending():
+            return None
+        plan = rec["plan"]
+        N = plan["N"]
+        max_len = self.model_config.max_model_len
+        next_specs: List[Dict[str, Any]] = []
+        live = 0
+        for sp_ in plan["specs"]:
+            nxt = dict(sp_, active=False)
+            next_specs.append(nxt)
+            if not sp_["active"]:
+                continue
+            req = sp_["req"]
+            if req.state is not RequestState.RUNNING:
+                continue
+            if req.deadline_expired():
+                return None
+            if sp_["rounds"][-1][0] != "dec":
+                return None     # still mid-prompt after N rounds
+            gen_min = sp_["gen0"] + sp_["min_emit"]
+            if gen_min >= req.sampling.max_tokens:
+                continue        # certainly finishes in flight: pad row
+            nd = sp_["rounds"][-1][1]
+            cover = sp_["cover"] + N * (nd + 1)
+            if cover > max_len:
+                return None
+            nxt.update(active=True, stride=nd + 1,
+                       rounds=[("dec", nd)] * N, cover=cover,
+                       gen0=gen_min, min_emit=N)
+            live += 1
+        if live == 0:
+            return None
+        allocated: List[Tuple[Request, Any]] = []
+        for nxt in next_specs:
+            if not nxt["active"]:
+                continue
+            got = self.kv_manager.allocate(nxt["req"], nxt["cover"])
+            if got is None:
+                for r_, blocks in reversed(allocated):
+                    self.kv_manager.release_tail(r_, blocks)
+                return None
+            allocated.append((nxt["req"], got))
+        shards: List[List] = [[] for _ in range(self.dp)]
+        for nxt, row in zip(next_specs, plan["rows"]):
+            shards[int(row) // plan["S_l"]].append(nxt)
+        nplan = self._fms_build(shards, N, self._step_count + N,
+                                S_l=plan["S_l"])
+        return self._fms_dispatch(nplan, carry_dev=rec["carry"])
 
     # ---------- public API ----------
 
@@ -1597,8 +2476,13 @@ class EngineCore:
             # Pipelined decode: queue the successor block on the device
             # FIRST, then retire the in-flight one — host-side token
             # processing runs while the device crunches the successor.
-            nxt = self._ms_try_extend(self._inflight)
-            outputs.extend(self._ms_retire(self._inflight))
+            rec = self._inflight
+            if isinstance(rec, dict) and rec.get("kind") == "fms":
+                nxt = self._fms_try_extend(rec)
+                outputs.extend(self._fms_retire(rec, successor=nxt))
+            else:
+                nxt = self._ms_try_extend(rec)
+                outputs.extend(self._ms_retire(rec))
             self._inflight = nxt
             return outputs
         sched = self.scheduler.schedule()
@@ -1630,7 +2514,18 @@ class EngineCore:
             # anymore (and so no draft-allocation rollback): spec decode
             # stays on under continuous prefill traffic, and a prefill
             # chunk rides the same per-layer expert-weight stream the
-            # decodes already pay for.
+            # decodes already pay for.  With num_scheduler_steps > 1 the
+            # mixed round becomes the body of an N-round lax.scan — one
+            # dispatch + one host fetch per N rounds, double-buffered
+            # under async scheduling like the classic multistep path.
+            plan = self._fms_plan(sched)
+            if plan is not None:
+                rec = self._fms_dispatch(plan)
+                if self.config.async_scheduling:
+                    self._inflight = rec
+                    return outputs   # this dispatch retires next step
+                outputs.extend(self._fms_retire(rec))
+                return outputs
             outputs.extend(self._run_fused(sched))
             return outputs
 
@@ -1654,6 +2549,8 @@ class EngineCore:
         fn = self._step_fn_top if want_top else self._step_fn
         ids, logprobs, self.kv_cache, routed, top = fn(
             self.params, self.kv_cache, batch, step_key)
+        self._dispatch_count += 1
+        self.metrics.engine_dispatches.inc()
         # ONE batched fetch: each device_get is a full tunnel round trip
         # (~tens of ms against a remote chip), and chosen-token logprobs are
         # only materialized when some request asked for them.
@@ -1668,6 +2565,7 @@ class EngineCore:
         if top is not None:
             top = (np.asarray(fetched[-2]), np.asarray(fetched[-1]))
         self._step_count += 1
+        self.metrics.engine_steps.inc()
         # Step-boundary span: stamped AFTER the batched fetch (the one
         # intended sync point above) from plain clock reads — tracing
         # adds no sync of its own.  Parented on the first traced request

@@ -158,6 +158,30 @@ def compute_logprobs(logits: jax.Array, token_ids: jax.Array) -> jax.Array:
     return jnp.take_along_axis(logp, token_ids[:, None], axis=-1)[:, 0]
 
 
+def verify_logprobs(logits: jax.Array, ids: jax.Array,
+                    top_n: int = 0):
+    """Per-position logprobs over the K+1 verify stride, on device.
+
+    ``logits`` [S*(K+1), V] is the verify-stride layout ``spec_verify``
+    consumes; ``ids`` [S, K+1] are its sampled tokens.  Returns
+    ``lp [S, K+1]`` (and, when ``top_n > 0``, ``top_ids [S, K+1, n]`` /
+    ``top_lps [S, K+1, n]``) — EVERY stride position is scored so the
+    host can slice the accepted prefix ``[:accepted+1]`` after the fused
+    fetch without a second device round trip.  Rejected-draft positions
+    are computed and discarded (they share the already-materialized
+    log-softmax); a row whose stride replicates one chunk-last token
+    (prefill rows in the mixed round) just repeats position 0's value.
+    This is what lets logprobs rows ride the spec path instead of
+    demoting to the classic epilogue."""
+    S, Q = ids.shape
+    flat = ids.reshape(-1)
+    if top_n <= 0:
+        return compute_logprobs(logits, flat).reshape(S, Q)
+    chosen, top_ids, top_lps = compute_top_logprobs(logits, flat, top_n)
+    return (chosen.reshape(S, Q), top_ids.reshape(S, Q, top_n),
+            top_lps.reshape(S, Q, top_n))
+
+
 def compute_top_logprobs(logits: jax.Array, token_ids: jax.Array,
                          n: int = 20):   # OpenAI chat's top_logprobs max
     """Chosen-token logprobs plus the top-``n`` alternatives.

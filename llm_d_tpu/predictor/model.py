@@ -178,12 +178,21 @@ class StepTimeModel:
         return float(max(0.0, self._coef @ x))
 
     def chunk_for(self, decode_tokens: int, target_ms: float,
-                  lo: int, hi: int) -> int:
+                  lo: int, hi: int, rounds: int = 1) -> int:
         """Largest prefill chunk in [lo, hi] whose predicted step time
         stays under ``target_ms`` at the given decode load.  Untrained ->
         ``hi`` (no evidence to cut prefill throughput on); even ``lo``
         over target -> ``lo`` (the chunk floor keeps prefills making
-        progress — starving them entirely would deadlock admission)."""
+        progress — starving them entirely would deadlock admission).
+
+        ``rounds`` accounts for N-round fused-multistep dispatch: the
+        host only syncs every N rounds, so the burst a waiting decode
+        token observes is N back-to-back rounds and the PER-ROUND
+        budget is target_ms / N — without this, LLMD_PREFILL_CHUNK=auto
+        would size chunks as if each round retired individually and
+        oversize them N×.  (The model's samples are already per-round:
+        the fused retire divides its wall time by N before observe().)"""
+        target_ms = target_ms / max(1, rounds)
         if not self.trained or target_ms <= 0 or hi <= lo:
             return hi
         if self.predict(hi, decode_tokens) <= target_ms:

@@ -1161,7 +1161,8 @@ def engine_config_from_args(args) -> EngineConfig:
         dbo_prefill_token_threshold=args.dbo_prefill_token_threshold,
         enable_eplb=args.enable_eplb,
         eplb_config=json.loads(args.eplb_config) if args.eplb_config else None,
-        spec_k=args.spec_k)
+        spec_k=args.spec_k,
+        spec_strict=(True if args.spec_strict else None))
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -1306,6 +1307,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
              "sampling, with per-request adaptive backoff to K=1 on low "
              "acceptance.  Default: LLMD_SPEC_K (0 = off); "
              "LLMD_SPEC_DECODE=off is the kill switch")
+    p.add_argument(
+        "--spec-strict", action="store_true",
+        help="fail startup instead of demoting when a requested feature "
+             "(spec decode under an incompatible config) cannot be "
+             "armed — no silently degraded serving configs.  Runtime "
+             "per-request demotions still only count "
+             "llmd_tpu:engine_feature_disabled_total.  Default: "
+             "LLMD_SPEC_STRICT (0 = demote-and-count)")
     p.add_argument(
         "--kv-transfer-config", default=None,
         help="JSON KV-connector config for PD disaggregation, e.g. "

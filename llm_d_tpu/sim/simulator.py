@@ -73,6 +73,7 @@ class SimConfig:
         spec_acceptance: float = 0.7,
         prefill_chunk: Optional[int] = None,
         step_prefill_token_ms: float = 0.0,
+        num_scheduler_steps: int = 1,
     ) -> None:
         self.model = model
         self.ttft_ms = ttft_ms
@@ -96,6 +97,12 @@ class SimConfig:
         # step_prefill_token_ms = 0 keeps timing byte-identical.
         self.prefill_chunk = prefill_chunk
         self.step_prefill_token_ms = step_prefill_token_ms
+        # Fused-multistep mirror (round 16): the engine dispatches ONE
+        # N-round program and syncs once per dispatch, so the sim charges
+        # its per-step latency in N-step bursts — same total time, TPOT
+        # jitter amortized, exactly the shape the real pipeline produces.
+        # 1 = classic per-step timing (byte-identical to round 15).
+        self.num_scheduler_steps = num_scheduler_steps
 
 
 class InferenceSimulator:
@@ -154,6 +161,7 @@ class InferenceSimulator:
         self.prefill_chunk = max(0, int(chunk))
         self.step_prefill_token_ms = max(
             0.0, float(config.step_prefill_token_ms))
+        self.num_scheduler_steps = max(1, int(config.num_scheduler_steps))
         self._prefill_inflight = 0
         self._running = 0
         self._waiting = 0
@@ -417,6 +425,8 @@ class InferenceSimulator:
             for csize in plan:
                 step_starts[pos] = csize
                 pos += csize
+            pending_ms = 0.0
+            pending_steps = 0
             for i in range(start, ticket["max_tokens"]):
                 if self.dead:
                     raise RuntimeError("engine dead")
@@ -435,8 +445,18 @@ class InferenceSimulator:
                         step_starts[i] - 1)
                 if emitted > 0 and (not step_starts or i in step_starts):
                     step_ms = c.tpot_ms + self._mixed_step_extra_ms()
-                    await asyncio.sleep(step_ms / 1e3)
-                    self.metrics.inter_token_latency.observe(step_ms / 1e3)
+                    pending_ms += step_ms
+                    pending_steps += 1
+                    if pending_steps >= self.num_scheduler_steps:
+                        # One host dispatch per N sim steps (fused-
+                        # multistep mirror): the sleep lands as an
+                        # N-round burst and ITL is observed at its per-
+                        # step mean — jitter amortized, total unchanged.
+                        await asyncio.sleep(pending_ms / 1e3)
+                        self.metrics.inter_token_latency.observe(
+                            pending_ms / 1e3 / pending_steps)
+                        pending_ms = 0.0
+                        pending_steps = 0
                 if deadline_epoch is not None \
                         and time.time() > deadline_epoch:
                     ticket["expired"] = True
@@ -787,6 +807,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="per-token latency surcharge a decode step pays "
                         "for prefill tokens sharing its fused round "
                         "(0 = off, timing unchanged)")
+    p.add_argument("--num-scheduler-steps", type=int, default=1,
+                   help="fused-multistep mirror: sim steps per host "
+                        "dispatch (latency charged in N-step bursts, "
+                        "TPOT jitter amortized; 1 = per-step timing)")
     args = p.parse_args(argv)
 
     cfg = SimConfig(
@@ -796,7 +820,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         startup_delay_s=args.startup_delay, spec_k=args.spec_k,
         spec_acceptance=args.spec_acceptance,
         prefill_chunk=args.prefill_chunk,
-        step_prefill_token_ms=args.step_prefill_token_ms)
+        step_prefill_token_ms=args.step_prefill_token_ms,
+        num_scheduler_steps=args.num_scheduler_steps)
     logging.basicConfig(level=logging.INFO)
     web.run_app(build_sim_server(cfg).build_app(),
                 host=args.host, port=args.port)

@@ -64,6 +64,20 @@ SPEC_ACCEPTED_METRIC = "llmd_tpu:spec_accepted_tokens_total"
 # stream.
 STEP_PREFILL_TOKENS_METRIC = "llmd_tpu:step_prefill_tokens_total"
 STEP_DECODE_TOKENS_METRIC = "llmd_tpu:step_decode_tokens_total"
+# Composition demotions (round 16, everything-on): every surviving
+# demotion — a per-request fall-off (a do_remote_decode row leaving the
+# fused spec path, a fused-multistep plan bailing to single-round) or a
+# startup feature disable — increments this by (feature, blocker).
+# After round 16 the startup set is empty by design, so a nonzero
+# startup-labeled rate is a regression; LLMD_SPEC_STRICT=1 turns a
+# startup disable into a refused boot instead of a counter bump.
+FEATURE_DISABLED_METRIC = "llmd_tpu:engine_feature_disabled_total"
+# Device dispatches: one compiled-program launch plus one host fetch.
+# rate(steps)/rate(dispatches) is the N-round amortization ratio — ~N
+# under fused multistep, ~1 on the classic per-step path — the
+# dashboard proof that host round-trips per decoded token dropped.
+ENGINE_DISPATCH_METRIC = "llmd_tpu:engine_dispatch_total"
+ENGINE_STEP_METRIC = "llmd_tpu:engine_steps_total"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -212,6 +226,21 @@ class EngineMetrics:
             STEP_DECODE_TOKENS_METRIC,
             "Decode + speculative-verify tokens computed per engine "
             "step.")
+        # Composition demotions + dispatch amortization (see the
+        # FEATURE_DISABLED / ENGINE_DISPATCH constants above).
+        self._feature_disabled = Counter(
+            FEATURE_DISABLED_METRIC,
+            "Requested features demoted, at startup or per request, by "
+            "feature and blocker.",
+            ["model_name", "feature", "blocker"], registry=self.registry)
+        self.engine_dispatches = counter(
+            ENGINE_DISPATCH_METRIC,
+            "Compiled-program dispatches (one host fetch each); "
+            "steps/dispatches is the multistep amortization ratio.")
+        self.engine_steps = counter(
+            ENGINE_STEP_METRIC,
+            "Engine rounds retired (a fused-multistep dispatch retires "
+            "N at once).")
 
     def observe_phase(self, phase: str, criticality: str,
                       seconds: float) -> None:
@@ -231,6 +260,11 @@ class EngineMetrics:
     def inc_deadline_exceeded(self, criticality: str) -> None:
         self._deadline_exceeded.labels(
             model_name=self.model_name, criticality=criticality).inc()
+
+    def inc_feature_disabled(self, feature: str, blocker: str) -> None:
+        self._feature_disabled.labels(
+            model_name=self.model_name, feature=feature,
+            blocker=blocker).inc()
 
     def add_collective_bytes(self, collective: str, dtype: str,
                              n: int) -> None:

@@ -64,6 +64,14 @@ python -m pytest tests/test_spec_decode.py -q
 # inside fused rounds, decode-priority budget invariants, adaptive chunk
 # sizing, and the LLMD_PREFILL_CHUNK=<n> kill switch).
 python -m pytest tests/test_mixed_fusion.py -q
+# Everything-on contract fail-fast (round 16: spec decode folded into
+# the fused-multistep pipeline — byte-identical parity of the full
+# composition (spec + mixed fusion + N-round multistep + async +
+# stacked-dp + EPLB) vs each feature alone and all-off, logprobs rows
+# on the spec path, per-shard rollback leak-freedom, the ~N x
+# step/dispatch amortization counters, LLMD_SPEC_STRICT refusing a
+# degraded boot, and chaos resume from a kill MID N-round dispatch).
+python -m pytest tests/test_everything_on.py -q
 python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_mla_quant.py \
@@ -72,4 +80,5 @@ python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_llmd_race.py \
     --ignore=tests/test_spec_decode.py \
     --ignore=tests/test_mixed_fusion.py \
+    --ignore=tests/test_everything_on.py \
     --ignore=tests/test_tracing.py

@@ -531,6 +531,58 @@ def _decode_regime(decode_T, args) -> str:
     return "streamed"
 
 
+def _time_ms_sync_each(thunk, iters: int, n: int) -> float:
+    """Time ``n`` back-to-back dispatches with a host sync after EACH —
+    the per-round retire cadence the engine pays without fused
+    multistep."""
+    thunk().block_until_ready()            # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        for _ in range(n):
+            thunk().block_until_ready()
+    return 1000.0 * (time.perf_counter() - t0) / iters
+
+
+def _run_mixed_multistep(args, paths, E, H, I, k, chunk_T, decode_T,
+                         iters) -> list:
+    """The --multistep axis: ONE ``lax.scan``-compiled N-round program
+    (each round the full mixed streamed kernel, output chained into the
+    next round's activations) vs the same N rounds as N single
+    dispatches with a host sync between each.  This is the ops-level
+    mirror of the engine's fused-multistep dispatch amortization
+    (``llmd_tpu:engine_steps_total / llmd_tpu:engine_dispatch_total``):
+    the scan column pays one dispatch + one sync for N rounds."""
+    from llm_d_tpu.ops import moe as moe_ops
+
+    total_T = chunk_T + decode_T
+    x, w, idx, quant = _build_case(
+        jax.random.PRNGKey(97), total_T, E, H, I, k)
+    single = paths["streamed"](x, w, idx, quant)
+
+    def scan_thunk(N):
+        @jax.jit
+        def f(x0):
+            def body(c, _):
+                y = moe_ops._streamed_int8_kernel_path(
+                    c, w, idx, quant, interpret=args.interpret)
+                return y.astype(c.dtype), None
+            c, _ = jax.lax.scan(body, x0, None, length=N)
+            return c
+        return lambda: f(x)
+
+    rows = []
+    for N in args.multistep:
+        scan_ms = _time_ms(scan_thunk(N), iters)
+        singles_ms = _time_ms_sync_each(single, iters, N)
+        rows.append({
+            "N": N, "total_T": total_T,
+            "ms": {"scan": round(scan_ms, 3),
+                   "singles": round(singles_ms, 3)},
+            "syncs_per_round": {"scan": round(1.0 / N, 3), "singles": 1.0},
+        })
+    return rows
+
+
 def run_mixed(args) -> dict:
     if args.interpret:
         E, H, I, k = 8, 256, 128, 2
@@ -572,7 +624,7 @@ def run_mixed(args) -> dict:
                 "fused": round(1e3 * total_T / max(fused_ms, 1e-9), 1),
                 "split": round(1e3 * total_T / max(split_ms, 1e-9), 1)},
         })
-    return {
+    doc = {
         "mode": "mixed",
         "backend": jax.default_backend(),
         "interpret": args.interpret,
@@ -582,6 +634,10 @@ def run_mixed(args) -> dict:
         "iters": iters,
         "points": points,
     }
+    if args.multistep:
+        doc["multistep"] = _run_mixed_multistep(
+            args, paths, E, H, I, k, chunk_sweep[0], decode_T, iters)
+    return doc
 
 
 def main(argv=None) -> int:
@@ -617,6 +673,15 @@ def main(argv=None) -> int:
                          "decode/verify tokens vs the same work as two "
                          "programs) instead of the MoE kernel family; "
                          "--t-sweep sets the chunk sizes")
+    ap.add_argument("--multistep", type=lambda s: [int(n) for n in
+                                                   s.split(",") if n],
+                    default=None,
+                    help="mixed mode: comma-separated round counts N — "
+                         "additionally time ONE lax.scan-compiled "
+                         "N-round mixed program (single dispatch + "
+                         "single sync) against N single dispatches with "
+                         "a host sync each, the ops-level mirror of the "
+                         "engine's fused-multistep amortization")
     ap.add_argument("--k-sweep", type=str, default=None,
                     help="spec mode: comma-separated draft depths "
                          "(default 1,2,4,8 on chip; 1,2,4 interpreted)")

@@ -1,0 +1,503 @@
+"""Everything-on: the round-16 composition contract (fail-fast in ci-gate).
+
+The round-16 tentpole: the PR-15 mixed round becomes the BODY of the
+fused-multistep pipeline — one compiled N-round program carries spec
+draft state, KV/rollback state, sampling RNG continuity and per-row
+chunk progress on device, with ONE host fetch per N rounds — and the
+last composition gates (multistep/async x spec, stacked-dp x spec,
+EPLB x spec, logprobs demotion) are deleted.  ONE default config runs
+spec + mixed fusion + fused multistep + async + stacked dp + EPLB
+together.
+
+The contract this suite pins:
+
+  - everything-on output is BYTE-IDENTICAL to each feature alone and to
+    all-off, greedy AND seeded (``fold_in(seed, gen_idx)`` continuity);
+  - mixed rounds with staggered prefill joins keep drafting inside the
+    N-round program, byte-identical;
+  - logprobs rows ride the spec path end to end (the demotion is gone);
+  - stacked-dp per-shard rollback leaves the paged-KV pool leak-free;
+  - host round-trips per decoded token drop ~N x (step/dispatch
+    counters, exported as llmd_tpu:engine_steps_total /
+    llmd_tpu:engine_dispatch_total);
+  - LLMD_SPEC_STRICT / --spec-strict refuses a silently degraded boot;
+    non-strict demotions are counted
+    (llmd_tpu:engine_feature_disabled_total{feature,blocker});
+  - chaos acceptance: a seeded engine kill MID N-round dispatch resumes
+    through the journaled failover at exact offsets, zero client breaks.
+
+All CPU, tier-1 safe.
+"""
+
+import asyncio
+import pathlib
+
+import jax
+import pytest
+
+from llm_d_tpu.engine.engine import EngineConfig, EngineCore
+from llm_d_tpu.engine.request import Request
+from llm_d_tpu.ops.sampling import SamplingParams
+from llm_d_tpu.parallel.mesh import MeshConfig
+from llm_d_tpu.sim.simulator import SimConfig, build_sim_server
+from llm_d_tpu.server.stream_resume import (
+    parse_stream_payload,
+    verify_continuity,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+ENGINE_KW = dict(model="tiny", block_size=4, num_blocks=64, max_num_seqs=8,
+                 max_num_batched_tokens=64, min_token_bucket=16,
+                 min_seq_bucket=4)
+
+# The everything-on knobs this whole file is about: spec decode, fused
+# multistep (N=2 rounds per dispatch) and async double-buffering in ONE
+# config.  Stacked dp + EPLB join in the mesh tests below.
+EVERYTHING = dict(spec_k=4, num_scheduler_steps=2, async_scheduling=True)
+
+DP_MESH = MeshConfig(dp=4, sp=1, tp=2)
+
+
+def greedy_req(rid, prompt, n=12, **kw):
+    return Request(request_id=rid, prompt_token_ids=list(prompt),
+                   sampling=SamplingParams(temperature=0.0, max_tokens=n,
+                                           ignore_eos=True), **kw)
+
+
+def seeded_req(rid, prompt, n=12, seed=7, **kw):
+    return Request(request_id=rid, prompt_token_ids=list(prompt),
+                   sampling=SamplingParams(temperature=0.9, top_p=0.95,
+                                           top_k=20, max_tokens=n,
+                                           seed=seed, ignore_eos=True),
+                   **kw)
+
+
+def workload():
+    """Greedy + seeded rows, mixed prompt lengths — the parity payload
+    every composition must reproduce byte-for-byte."""
+    return [greedy_req("g0", [1, 5, 9, 200, 3, 17, 42]),
+            greedy_req("g1", [4, 4, 4, 8]),
+            greedy_req("g2", list(range(40, 55)), n=8),
+            seeded_req("s0", [7, 7, 2, 300], seed=123),
+            seeded_req("s1", [9, 1, 9, 1, 9], seed=31337, n=10)]
+
+
+def _free_blocks(engine):
+    return engine.kv_manager.num_free_blocks
+
+
+def _metric_value(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# the parity matrix: everything-on vs each feature alone vs all-off
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def all_off_expected():
+    return EngineCore(EngineConfig(**ENGINE_KW)).generate(workload())
+
+
+@pytest.mark.parametrize("name,cfg", [
+    ("spec_only", dict(spec_k=4)),
+    ("multistep_only", dict(num_scheduler_steps=2)),
+    ("async_only", dict(num_scheduler_steps=2, async_scheduling=True)),
+    ("spec_multistep", dict(spec_k=4, num_scheduler_steps=2)),
+    ("everything_on", EVERYTHING),
+    ("everything_on_n4", dict(spec_k=4, num_scheduler_steps=4,
+                              async_scheduling=True)),
+])
+def test_parity_matrix_byte_identical(name, cfg, all_off_expected):
+    """Each composition — including the ones the deleted gates used to
+    forbid (spec x multistep, spec x async) — emits byte-identical
+    greedy AND seeded output."""
+    eng = EngineCore(EngineConfig(**cfg, **ENGINE_KW))
+    if cfg.get("spec_k"):
+        assert eng.spec_k == cfg["spec_k"], \
+            f"{name}: spec decode demoted at startup"
+    assert eng.generate(workload()) == all_off_expected, name
+
+
+def test_everything_on_leaves_pool_leak_free():
+    """After the everything-on workload drains, every KV block is back
+    in the pool — the N-round program's implicit rejected-draft
+    rollback plus the single retire-time trim settle all speculative
+    over-allocation."""
+    eng = EngineCore(EngineConfig(**EVERYTHING, **ENGINE_KW))
+    before = _free_blocks(eng)
+    eng.generate(workload())
+    assert _free_blocks(eng) == before
+    assert eng.scheduler.num_running == 0 and not eng.has_work()
+
+
+# ---------------------------------------------------------------------------
+# mixed rounds: staggered prefill joins inside the N-round program
+# ---------------------------------------------------------------------------
+
+def _run_staggered(engine, first, rest):
+    # Collect from step 0: an N-round dispatch retires more tokens per
+    # step() than the classic engine, so a dropped warm-up prefix would
+    # differ in length between compositions.
+    outs = []
+    engine.add_request(first)
+    for _ in range(4):
+        outs.extend(engine.step())
+    pending = list(rest)
+    while engine.has_work() or pending:
+        if pending:
+            engine.add_request(pending.pop(0))
+        outs.extend(engine.step())
+    tokens = {}
+    for o in outs:
+        tokens.setdefault(o.request_id, []).extend(o.new_token_ids)
+    return tokens
+
+
+def test_staggered_prefill_joins_byte_identical():
+    """Joiners' prefill chunks ride the SAME N-round dispatches as the
+    running decodes (chunk rounds + dec rounds in one program) and the
+    output still matches the all-off engine byte-for-byte; the resident
+    decode keeps drafting across the joins."""
+    def load():
+        first = greedy_req("first", [1, 5, 9, 200, 3], n=14)
+        rest = [greedy_req(f"j{i}", list(range(10 + i, 26 + i)), n=6)
+                for i in range(3)]
+        rest.append(seeded_req("js", [3, 1, 4, 1, 5, 9, 2, 6], seed=99,
+                               n=8))
+        return first, rest
+
+    base = EngineCore(EngineConfig(**ENGINE_KW))
+    want = _run_staggered(base, *load())
+
+    eng = EngineCore(EngineConfig(**EVERYTHING, **ENGINE_KW))
+    first, rest = load()
+    outs = []
+    eng.add_request(first)
+    for _ in range(4):
+        outs.extend(eng.step())
+    pending = list(rest)
+    saw_mixed = False
+    while eng.has_work() or pending:
+        if pending:
+            eng.add_request(pending.pop(0))
+        outs.extend(eng.step())
+        s = eng.scheduler.last_schedule_stats
+        saw_mixed |= (s.get("prefill_tokens", 0) > 0
+                      and s.get("spec_tokens", 0) > 0)
+    got = {}
+    for o in outs:
+        got.setdefault(o.request_id, []).extend(o.new_token_ids)
+    assert saw_mixed, "no pass scheduled prefill chunks + spec decodes"
+    assert first.spec_drafted > 0, "resident decode stopped drafting"
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# logprobs rows ride the spec path (the demotion is deleted)
+# ---------------------------------------------------------------------------
+
+def test_logprobs_rows_on_spec_path_everything_on():
+    """A logprobs request under the full composition: tokens are
+    byte-identical to the all-off engine, the row itself DRAFTS
+    (spec_drafted > 0 — the old path demoted it to classic), and the
+    per-position logprob values ride along on device.  Values compare
+    at 1e-2: the N-round program batches/pads the verify stride
+    differently from the classic single-row epilogue, which moves
+    float32 sums at the 1e-3 level without moving any argmax."""
+    def lp_req(rid):
+        return Request(request_id=rid, prompt_token_ids=[5, 6, 7],
+                       sampling=SamplingParams(temperature=0.0,
+                                               max_tokens=6,
+                                               ignore_eos=True,
+                                               logprobs=5))
+
+    base = EngineCore(EngineConfig(**ENGINE_KW))
+    want_outs = []
+    base.add_request(lp_req("w"))
+    while base.has_work():
+        want_outs.extend(base.step())
+    want_tokens = [t for o in want_outs for t in o.new_token_ids]
+    want_lps = [v for o in want_outs for v in (o.logprobs or [])]
+
+    eng = EngineCore(EngineConfig(**EVERYTHING, **ENGINE_KW))
+    req = lp_req("lp")
+    eng.add_request(req)
+    outs = []
+    while eng.has_work():
+        outs.extend(eng.step())
+    got_tokens = [t for o in outs for t in o.new_token_ids]
+    got_lps = [v for o in outs for v in (o.logprobs or [])]
+    got_tops = [t for o in outs for t in (o.top_logprobs or [])]
+    assert req.spec_drafted > 0, "logprobs row fell off the spec path"
+    assert got_tokens == want_tokens
+    assert len(got_lps) == len(got_tops) == 6
+    for g, w in zip(got_lps, want_lps):
+        assert abs(g - w) < 1e-2
+    for tok, top in zip(got_tokens, got_tops):
+        assert tok in top, "sampled token missing from its top-logprobs"
+
+
+# ---------------------------------------------------------------------------
+# stacked dp + EPLB: the full mesh composition
+# ---------------------------------------------------------------------------
+
+def test_stacked_dp_eplb_everything_on_parity_and_leak_free(devices):
+    """The widest composition: tiny-moe over the (dp=4, tp=2) mesh with
+    EPLB, spec, fused multistep AND async — byte-identical to the SAME
+    mesh running plain (the composition contract: features must not
+    move tokens; cross-mesh seeded parity is not in any contract, MoE
+    collectives reorder float sums and temp>0 sampling amplifies that —
+    test_spmd_dp pins the greedy cross-mesh half), and every shard's
+    KV blocks return to the pool (per-shard verify strides +
+    shard-local trims)."""
+    kw = dict(ENGINE_KW, model="tiny-moe", allow_device_subset=True)
+    base = EngineCore(EngineConfig(mesh=DP_MESH, **kw))
+    expected = base.generate(workload())
+    host_params = jax.device_get(base.params)
+    eng = EngineCore(EngineConfig(mesh=DP_MESH, enable_eplb=True,
+                                  **EVERYTHING, **kw),
+                     params=host_params)
+    assert eng.spec_k == 4, "spec decode demoted under stacked dp"
+    before = _free_blocks(eng)
+    assert eng.generate(workload()) == expected
+    assert _free_blocks(eng) == before, "stacked-dp shard leaked blocks"
+
+
+# ---------------------------------------------------------------------------
+# the point of it all: ~N x fewer host round-trips per decoded token
+# ---------------------------------------------------------------------------
+
+def test_dispatch_amortization_counters():
+    """The N-round program retires N engine rounds per host dispatch:
+    the step/dispatch ratio lands well above the classic 1:1 (the
+    acceptance floor is 1.5 x at N=2), and the same ratio is exported
+    through llmd_tpu:engine_steps_total / engine_dispatch_total."""
+    eng = EngineCore(EngineConfig(**EVERYTHING, **ENGINE_KW))
+    reqs = [greedy_req(f"d{i}", [1 + i, 2, 3], n=16) for i in range(3)]
+    eng.generate(reqs)
+    steps, dispatches = eng._step_count, eng._dispatch_count
+    assert dispatches > 0
+    assert steps > 1.5 * dispatches, (steps, dispatches)
+    mtext = eng.metrics.render().decode()
+    assert _metric_value(mtext, "llmd_tpu:engine_steps_total") == steps
+    assert _metric_value(
+        mtext, "llmd_tpu:engine_dispatch_total") == dispatches
+
+    # The classic engine is the 1:1 baseline the ratio is against.
+    base = EngineCore(EngineConfig(**ENGINE_KW))
+    base.generate([greedy_req(f"b{i}", [1 + i, 2, 3], n=16)
+                   for i in range(3)])
+    assert base._step_count == base._dispatch_count
+
+
+# ---------------------------------------------------------------------------
+# strict composition mode: refuse the silently degraded boot
+# ---------------------------------------------------------------------------
+
+def test_spec_strict_refuses_degraded_boot(monkeypatch):
+    """With a (simulated) startup blocker: --spec-strict refuses to
+    boot; non-strict boots degraded and counts the demotion in
+    llmd_tpu:engine_feature_disabled_total{feature,blocker}."""
+    monkeypatch.setattr(EngineCore, "_spec_blockers",
+                        lambda self: ["test_blocker"])
+    with pytest.raises(ValueError, match="test_blocker"):
+        EngineCore(EngineConfig(spec_k=2, spec_strict=True, **ENGINE_KW))
+    eng = EngineCore(EngineConfig(spec_k=2, spec_strict=False,
+                                  **ENGINE_KW))
+    assert eng.spec_k == 0 and eng._spec_fn is None
+    mtext = eng.metrics.render().decode()
+    assert "llmd_tpu:engine_feature_disabled_total" in mtext
+    assert "test_blocker" in mtext
+
+
+def test_spec_strict_env_var(monkeypatch):
+    """LLMD_SPEC_STRICT=1 is the env spelling of --spec-strict, and a
+    blocker-free boot under strict mode arms everything."""
+    monkeypatch.setenv("LLMD_SPEC_STRICT", "1")
+    monkeypatch.setattr(EngineCore, "_spec_blockers",
+                        lambda self: ["test_blocker"])
+    with pytest.raises(ValueError, match="LLMD_SPEC_STRICT"):
+        EngineCore(EngineConfig(spec_k=2, **ENGINE_KW))
+    monkeypatch.undo()
+    monkeypatch.setenv("LLMD_SPEC_STRICT", "1")
+    eng = EngineCore(EngineConfig(**EVERYTHING, **ENGINE_KW))
+    assert eng.spec_k == 4, "blocker-free strict boot must arm spec"
+
+
+def test_spec_strict_cli_flag():
+    """--spec-strict wires through the server arg parser into
+    EngineConfig.spec_strict."""
+    from llm_d_tpu.server.openai import (
+        build_arg_parser, engine_config_from_args)
+    args = build_arg_parser().parse_args(
+        ["--model", "tiny", "--spec-strict"])
+    assert engine_config_from_args(args).spec_strict is True
+    args = build_arg_parser().parse_args(["--model", "tiny"])
+    assert engine_config_from_args(args).spec_strict is None
+
+
+# ---------------------------------------------------------------------------
+# sim mirror: N scheduler steps per host dispatch
+# ---------------------------------------------------------------------------
+
+def test_sim_num_scheduler_steps_token_identical():
+    """SimConfig.num_scheduler_steps composes with the spec/chunk
+    mirrors: N=4 batches the sleep/ITL accounting per dispatch but the
+    token stream is byte-identical to N=1 (timing-only change)."""
+    import aiohttp
+    from test_stream_recovery import _cleanup, _start_app, free_port
+
+    async def one(cfg):
+        srv = build_sim_server(cfg)
+        port = free_port()
+        runner = await _start_app(srv.build_app(), port)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                for _ in range(100):
+                    async with sess.get(
+                            f"http://127.0.0.1:{port}/v1/models") as r:
+                        if r.status == 200:
+                            break
+                    await asyncio.sleep(0.02)
+                async with sess.post(
+                        f"http://127.0.0.1:{port}/v1/completions",
+                        json={"prompt": "multistep sim", "max_tokens": 10,
+                              "stream": True}) as r:
+                    assert r.status == 200
+                    payload = await r.read()
+        finally:
+            await _cleanup([runner])
+        text, metas, done = parse_stream_payload(payload)
+        assert done
+        assert verify_continuity(metas, expect_total=10) == []
+        return text
+
+    async def run():
+        base = await one(SimConfig(ttft_ms=1.0, tpot_ms=2.0,
+                                   spec_k=4, spec_acceptance=0.8))
+        fused = await one(SimConfig(ttft_ms=1.0, tpot_ms=2.0,
+                                    spec_k=4, spec_acceptance=0.8,
+                                    num_scheduler_steps=4))
+        assert fused == base
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: seeded kill MID N-round dispatch, exact-offset resume
+# ---------------------------------------------------------------------------
+
+def test_chaos_everything_on_kill_mid_dispatch_resumes_exact():
+    """THE chaos bar for round 16: a 4-replica sim fleet running the
+    everything-on mirror (spec_k=2, acceptance 0.8, num_scheduler_steps
+    =4) behind the gateway under streaming load; a seeded engine kill
+    lands MID N-round dispatch, where the journal's last fetch is up to
+    N rounds behind the engine's internal state.  The resume must still
+    splice at EXACT journal offsets: zero client-visible breaks, zero
+    duplicate/missing token indices, byte-identical text, recovery
+    recorded."""
+    import aiohttp
+    from test_stream_recovery import (
+        _cleanup, _metric_value, _start_app, free_port)
+    from llm_d_tpu.epp.datastore import EndpointState
+    from llm_d_tpu.epp.service import build_gateway
+    from llm_d_tpu.sim.simulator import _LOREM
+    from llm_d_tpu.utils.faultinject import FaultInjector, install, reset
+
+    def sim_text(sim, prompt, max_tokens):
+        pids = sim._tokenize(prompt)
+        return "".join(_LOREM[(len(pids) + i) % len(_LOREM)] + " "
+                       for i in range(max_tokens))
+
+    inj = install(FaultInjector.from_spec("", seed=0))
+    inj.add_rule("engine.step", after=25, count=1)
+
+    async def run():
+        ports = [free_port() for _ in range(4)]
+        runners, sims = [], []
+        for i, port in enumerate(ports):
+            srv = build_sim_server(SimConfig(
+                model=f"sim-{i}", ttft_ms=1.0, tpot_ms=2.0,
+                spec_k=2, spec_acceptance=0.8, num_scheduler_steps=4))
+            sims.append(srv.sim)
+            runners.append(await _start_app(srv.build_app(), port))
+        endpoints = [EndpointState(address=f"127.0.0.1:{p}")
+                     for p in ports]
+        gw = build_gateway(endpoints, scrape_interval_s=0.05,
+                           retry_attempts=3)
+        gw_port = free_port()
+        gw_runner = await _start_app(gw.build_app(), gw_port)
+        url = f"http://127.0.0.1:{gw_port}/v1/completions"
+        for _ in range(200):
+            if all(e.ready for e in gw.datastore.candidates()):
+                break
+            await asyncio.sleep(0.02)
+
+        max_tokens = 8
+        results = []
+        stop = asyncio.Event()
+
+        async def load_worker(sess, wid):
+            i = 0
+            while not stop.is_set():
+                i += 1
+                prompt = f"everything chaos {wid} {i} tail"
+                try:
+                    async with sess.post(url, json={
+                            "prompt": prompt, "max_tokens": max_tokens,
+                            "stream": True}) as r:
+                        payload = await r.read()
+                        text, metas, done = parse_stream_payload(payload)
+                        results.append(
+                            (prompt, r.status, text, metas, done))
+                except aiohttp.ClientError as e:
+                    results.append((prompt, f"error:{type(e).__name__}",
+                                    "", [], False))
+                await asyncio.sleep(0.005)
+
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=30)) as sess:
+                workers = [asyncio.create_task(load_worker(sess, w))
+                           for w in range(3)]
+                for _ in range(600):
+                    await asyncio.sleep(0.02)
+                    if inj.stats().get("engine.step", {}).get(
+                            "fired", 0) >= 1 and len(results) > 25:
+                        break
+                await asyncio.sleep(0.3)
+                stop.set()
+                await asyncio.gather(*workers, return_exceptions=True)
+        finally:
+            mtext = gw.scheduler.metrics.render().decode()
+            await _cleanup(runners + [gw_runner])
+
+        assert inj.stats()["engine.step"]["fired"] >= 1
+        assert any(s.dead for s in sims), "no sim died"
+        bad = [(p, s) for p, s, *_ in results if s != 200]
+        assert not bad, f"client-visible failures: {bad[:5]}"
+        breaks = [p for p, _s, _t, _m, done in results if not done]
+        assert not breaks, f"{len(breaks)} stream break(s): {breaks[:3]}"
+        for prompt, _s, text, metas, _d in results:
+            assert verify_continuity(metas, expect_total=max_tokens) \
+                == [], prompt
+            assert text == sim_text(sims[0], prompt, max_tokens), \
+                f"token sequence diverged for {prompt!r}"
+        assert _metric_value(
+            mtext, "llmd_tpu:stream_resume_total") >= 1.0
+        assert _metric_value(
+            mtext, 'llmd_tpu:stream_resume_total{outcome="failed"}') \
+            == 0.0
+
+    try:
+        asyncio.run(asyncio.wait_for(run(), timeout=120))
+    finally:
+        reset()

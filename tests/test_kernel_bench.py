@@ -169,6 +169,36 @@ def test_kernel_bench_mixed_sweep_interpret(tmp_path, capsys):
             assert p["tok_s"][prog] > 0
 
 
+def test_kernel_bench_mixed_multistep_axis_interpret(tmp_path, capsys):
+    """--mixed --multistep (round 16): the N-round axis compiles ONE
+    lax.scan program chaining N mixed rounds (single dispatch + single
+    host sync) and times it against N single dispatches with a sync
+    each — the ops-level mirror of the engine's fused-multistep
+    amortization.  Both columns must actually run on the interpreter."""
+    mod = _kernel_bench()
+    out = tmp_path / "mixed_ms.json"
+    rc = mod.main(["--mixed", "--interpret", "--t-sweep", "16",
+                   "--multistep", "1,2", "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc == json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["mode"] == "mixed" and doc["timings_valid"] is False
+    rows = doc["multistep"]
+    assert [r["N"] for r in rows] == [1, 2]
+    for r in rows:
+        for prog in ("scan", "singles"):
+            assert isinstance(r["ms"][prog], float) and r["ms"][prog] > 0
+        # The dispatch accounting the axis exists to show: the scanned
+        # program pays 1/N host syncs per round.
+        assert r["syncs_per_round"]["scan"] == round(1.0 / r["N"], 3)
+        assert r["syncs_per_round"]["singles"] == 1.0
+    # Without the flag the document carries no multistep block.
+    rc = mod.main(["--mixed", "--interpret", "--t-sweep", "16"])
+    assert rc == 0
+    doc2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "multistep" not in doc2
+
+
 def test_kernel_bench_respects_path_caps(tmp_path):
     """--dense-max-t / --routed-max-t null out the capped paths (the
     shapes a real chip cannot run) and the recommendation still derives

@@ -247,6 +247,51 @@ def test_jit_catches_host_sync_and_dtypeless_literal(tmp_path):
     assert len(jit3) == 1 and "_retire" in jit3[0].message
 
 
+def test_jit_sync_inventory_second_in_loop_fetch_turns_red(tmp_path):
+    """The round-16 sync-point inventory contract: on the everything-on
+    path the fused-multistep retire is THE one documented host sync per
+    dispatch.  Violation twin: a second fetch sneaks into the dispatch
+    loop (here: the extend path peeking at device results every round)
+    — JIT003 fires on exactly that site.  Fixed twin: the single
+    annotated retire fetch — clean.  This is what keeps the ~N x
+    round-trip reduction from silently eroding back to per-round
+    syncs."""
+    violation = '''
+        import jax
+
+        class EngineCore:
+            def step(self):
+                nxt = self._fms_try_extend(self._inflight)
+                return self._fms_retire(self._inflight, nxt)
+
+            def _fms_retire(self, rec, successor):
+                # llmd: ignore[JIT] the one intended retire host sync
+                return jax.device_get(rec["ys"])
+
+            def _fms_try_extend(self, rec):
+                # a SECOND in-loop fetch: peeks every round -> JIT003
+                return jax.device_get(rec["carry"])
+    '''
+    fixed = violation.replace(
+        '''
+                # a SECOND in-loop fetch: peeks every round -> JIT003
+                return jax.device_get(rec["carry"])''', '''
+                return {"plan": rec["plan"]}''')
+
+    ctx = mini_repo(tmp_path, {"llm_d_tpu/engine/engine.py": violation})
+    findings, suppressed, _ = run_passes(ctx, [JitHygienePass()])
+    jit3 = [f for f in findings if f.rule == "JIT003"]
+    assert len(jit3) == 1 and "_fms_try_extend" in jit3[0].message
+    assert suppressed == 1          # the documented retire fetch
+
+    (tmp_path / "fixed").mkdir()
+    ctx2 = mini_repo(tmp_path / "fixed",
+                     {"llm_d_tpu/engine/engine.py": fixed})
+    findings2, suppressed2, _ = run_passes(ctx2, [JitHygienePass()])
+    assert [f for f in findings2 if f.rule == "JIT003"] == []
+    assert suppressed2 == 1
+
+
 def test_jit_passes_clean_engine_and_positional_dtype(tmp_path):
     ctx = mini_repo(tmp_path, {
         "llm_d_tpu/ops/kern.py": '''

@@ -279,7 +279,11 @@ def test_logprobs_rows_fused_with_identical_values(plain_engine):
     """A logprobs request decoding alongside plain spec rows: outputs
     AND logprob values match the plain engine, and the rounds that
     served it still scheduled draft tokens — the batch was not demoted
-    to the classic path."""
+    to the classic path.  Real verification (no fixed_accept): since
+    round 16 the logprobs row DRAFTS like any other, so a fixed-accept
+    coin would rewrite its output (that mode emits accepted drafts
+    verbatim) — real accept/reject keeps byte parity while the row
+    rides the spec path end to end."""
     def lp_req(rid):
         return Request(request_id=rid, prompt_token_ids=[5, 6, 7],
                        sampling=SamplingParams(temperature=0.0,
@@ -287,8 +291,7 @@ def test_logprobs_rows_fused_with_identical_values(plain_engine):
                                                ignore_eos=True,
                                                logprobs=5))
 
-    eng = EngineCore(EngineConfig(spec_k=4, spec_fixed_accept=0.8,
-                                  **ENGINE_KW))
+    eng = EngineCore(EngineConfig(spec_k=4, **ENGINE_KW))
     plain = greedy_req("pl", [1, 5, 9, 200, 3], n=10)
     eng.add_request(plain)
     for _ in range(3):

@@ -403,10 +403,12 @@ def test_default_engine_has_spec_off():
     assert eng.spec_k == 0 and eng._spec_fn is None
 
 
-def test_spec_disabled_under_multistep_and_async():
+def test_spec_stays_on_under_multistep_and_async():
+    # Round 16: the composition gate is gone — spec decode IS the body
+    # of the fused-multistep pipeline, so requesting both keeps both.
     eng = EngineCore(EngineConfig(spec_k=4, num_scheduler_steps=4,
-                                  **ENGINE_KW))
-    assert eng.spec_k == 0                  # fused pipeline wins, warned
+                                  async_scheduling=True, **ENGINE_KW))
+    assert eng.spec_k == 4 and eng._spec_fn is not None
 
 
 def test_server_flag_threads_spec_k():
