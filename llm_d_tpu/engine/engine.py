@@ -397,6 +397,8 @@ class EngineCore:
                 EplbConfig.from_dict(config.eplb_config))
             # Physical expert table replaces the logical weights on device.
             self.params = self.eplb.install(self.params, self.mesh, rules)
+            self.eplb.metrics = self.metrics
+            self.eplb.tracer = self.tracer
 
         num_slots = config.num_blocks * config.block_size
         # Folded layout [L, slots, row_width]: 128-lane-aligned page DMAs
@@ -880,9 +882,14 @@ class EngineCore:
             # only real sequences' rows count.  (A successor block already
             # dispatched keeps using the pre-rebalance physical
             # table+weights pair — consistent, balanced one block later.)
+            # Normalize [K, Lm, S, k] to the layer-leading [Lm, K*S, k]
+            # the per-layer load tracker expects.
+            routed_ms = jnp.moveaxis(
+                inflight["routed_dev"][:, :, rows, :], 1, 0)
+            routed_ms = routed_ms.reshape(
+                routed_ms.shape[0], -1, routed_ms.shape[-1])
             self.params = self.eplb.on_step(
-                inflight["routed_dev"][:, :, rows, :], self._step_count,
-                self.params, self.mesh)
+                routed_ms, self._step_count, self.params, self.mesh)
 
         outputs: List[RequestOutput] = []
         now = time.monotonic()

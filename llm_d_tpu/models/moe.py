@@ -204,9 +204,12 @@ def forward(
             e_bias=lp.get("e_bias"))
         if "replica_table" in lp:
             # EPLB: route to a physical replica of the logical expert
-            # (round-robin over its replicas; parallel.eplb plans the table).
+            # (round-robin over its replicas; parallel.eplb plans the
+            # table per layer — the layer index phases the walk so every
+            # layer doesn't start on replica 0).
             phys_idx = moe_ops.to_physical_experts(
-                idx, lp["replica_table"], lp["num_replicas"])
+                idx, lp["replica_table"], lp["num_replicas"],
+                phase=li - Ld)
         else:
             phys_idx = idx
         if quant_stacked is not None:

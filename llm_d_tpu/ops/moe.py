@@ -954,16 +954,22 @@ def to_physical_experts(
     idx: jax.Array,            # [T, k] logical expert ids
     replica_table: jax.Array,  # [E, max_r] physical slots per logical expert
     num_replicas: jax.Array,   # [E]
+    phase=0,                   # scalar round-robin offset (per layer)
 ) -> jax.Array:                # [T, k] physical expert ids
     """Map routed logical experts to EPLB physical replicas.
 
     Replica choice is round-robin over the (token, slot) index — load spreads
     across a hot expert's replicas without any cross-token coordination (the
-    dispatch stays embarrassingly parallel).  Used with
+    dispatch stays embarrassingly parallel).  ``phase`` offsets the
+    round-robin per layer: with per-layer plans the replica counts differ
+    between layers, and an unphased walk would hand every layer's replica 0
+    the same leading tokens — the phase decorrelates that without touching
+    the token->expert routing (replicas hold identical weights, so the
+    choice is output-invariant).  Used with
     ``parallel.eplb.plan_placement`` + ``gather_physical``.
     """
     T, k = idx.shape
-    slot = jnp.arange(T * k, dtype=jnp.int32).reshape(T, k)
+    slot = jnp.arange(T * k, dtype=jnp.int32).reshape(T, k) + phase
     r = slot % num_replicas[idx]
     return replica_table[idx, r]
 

@@ -8,10 +8,34 @@ divisibility constraint (E + redundant) % n_devices == 0.
 
 TPU translation: the *physical* expert table is what shards over the EP axis
 (``ops.moe.expert_ffn``); this module plans which logical expert occupies
-each physical slot from observed load, and the engine applies a new plan by
-re-gathering expert weights (an async device-to-device copy — no NVSHMEM
-re-registration, one of the places the TPU stack is simpler than the
-reference's).
+each physical slot from observed load, and the engine applies a new plan as
+a LIVE MIGRATION — the serving loop never waits on a weight copy:
+
+  1. **delta plans** — a fresh greedy placement is ALIGNED to the current
+     one (intra-shard slot order is semantically arbitrary, so slots that
+     already hold the right expert keep it; ``align_plan``), and only the
+     genuinely changed slots become moves, gated by imbalance-threshold
+     hysteresis (``LLMD_EPLB_IMBALANCE_THRESHOLD``) and min-delta
+     suppression so near-no-op plans cost nothing;
+  2. **double-buffered background staging** — each engine tick copies at
+     most ``LLMD_EPLB_MOVE_BUDGET`` changed slots (incl. int8 ``_q``/``_s``
+     sibling planes) into a spare slab as asynchronously dispatched
+     device-to-device gathers, overlapped with decode steps; the serving
+     params are read-only sources throughout, so every staged copy is
+     consistent whatever order the device retires them in;
+  3. **atomic flip** — once every move is staged and the slab is ready
+     (``jax.Array.is_ready``, never a host block), the weight references
+     and the stacked ``replica_table``/``num_replicas``/``phys_to_logical``
+     swap in ONE params-dict rebuild at a dispatch retire boundary: an
+     in-flight N-round program keeps its old, internally consistent pair;
+     the next dispatch sees the new one.  The host-blocked time of the
+     flip is the ``llmd_tpu:eplb_migration_stall_seconds`` metric — ~0 by
+     construction.
+
+Plans are PER LAYER: the replica tables are already stacked ``[L, E,
+max_r]`` for the model's layer scan, so per-layer load tracking and
+placement fall out, and the planner amortizes staging across layers within
+the one move budget.
 
 Plan algorithm (greedy, deterministic):
   1. replicas per logical expert ∝ load (largest-remainder rounding, every
@@ -22,11 +46,15 @@ Plan algorithm (greedy, deterministic):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
-from typing import Any, Dict, List, Optional, Sequence
+import time
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from llm_d_tpu.utils.config import env_float, env_int
 
 logger = logging.getLogger(__name__)
 
@@ -42,6 +70,23 @@ class EplbPlan:
     @property
     def num_physical(self) -> int:
         return len(self.phys_to_logical)
+
+
+def _plan_from_p2l(phys_to_logical: np.ndarray, num_logical: int,
+                   slots_per_shard: int) -> EplbPlan:
+    """Rebuild the replica table/counts from a slot assignment."""
+    E = num_logical
+    counts = np.bincount(phys_to_logical, minlength=E)
+    max_r = int(counts.max())
+    replica_table = np.zeros((E, max_r), np.int32)
+    num_replicas = np.zeros(E, np.int32)
+    for p, e in enumerate(phys_to_logical):
+        replica_table[e, num_replicas[e]] = p
+        num_replicas[e] += 1
+    for e in range(E):                           # pad with first replica
+        replica_table[e, num_replicas[e]:] = replica_table[e, 0]
+    return EplbPlan(E, phys_to_logical.astype(np.int32), replica_table,
+                    num_replicas, slots_per_shard)
 
 
 def plan_placement(
@@ -104,15 +149,7 @@ def plan_placement(
 
     phys_to_logical = np.asarray(
         [e for s in range(ep) for e in shard_slots[s]], np.int32)
-    max_r = int(counts.max())
-    replica_table = np.zeros((E, max_r), np.int32)
-    num_replicas = np.zeros(E, np.int32)
-    for p, e in enumerate(phys_to_logical):
-        replica_table[e, num_replicas[e]] = p
-        num_replicas[e] += 1
-    for e in range(E):                           # pad with first replica
-        replica_table[e, num_replicas[e]:] = replica_table[e, 0]
-    return EplbPlan(E, phys_to_logical, replica_table, num_replicas, spp)
+    return _plan_from_p2l(phys_to_logical, E, spp)
 
 
 def gather_physical(logical_weights, plan: EplbPlan):
@@ -124,28 +161,130 @@ def gather_physical(logical_weights, plan: EplbPlan):
     return logical_weights[plan.phys_to_logical]
 
 
+# ---------------------------------------------------------------------------
+# Delta planning: align a fresh placement to the serving one, then diff.
+# ---------------------------------------------------------------------------
+
+
+def align_plan(new_plan: EplbPlan, cur_plan: EplbPlan) -> EplbPlan:
+    """Permute ``new_plan``'s slot assignment WITHIN each shard so slots
+    that already hold the right expert keep it.
+
+    A shard's slot order is semantically arbitrary (the replica table is
+    rebuilt from the assignment), so any intra-shard permutation serves
+    the same placement.  Aligning before diffing is what makes delta
+    plans small: a fresh greedy pack of near-identical load would
+    otherwise reshuffle every slot.  An identical placement aligns to
+    ZERO moves."""
+    spp = new_plan.slots_per_shard
+    if cur_plan.slots_per_shard != spp or \
+            cur_plan.num_logical != new_plan.num_logical:
+        raise ValueError("align_plan: plans have different geometry")
+    ep = new_plan.num_physical // spp
+    aligned = np.full(new_plan.num_physical, -1, np.int32)
+    for s in range(ep):
+        lo = s * spp
+        cur = cur_plan.phys_to_logical[lo:lo + spp]
+        want = collections.Counter(
+            new_plan.phys_to_logical[lo:lo + spp].tolist())
+        free: List[int] = []
+        for i in range(spp):
+            e = int(cur[i])
+            if want.get(e, 0) > 0:               # keep the occupant
+                aligned[lo + i] = e
+                want[e] -= 1
+            else:
+                free.append(lo + i)
+        rest = sorted(e for e, n in want.items() for _ in range(n))
+        for i, e in zip(free, rest):
+            aligned[i] = e
+    return _plan_from_p2l(aligned, new_plan.num_logical, spp)
+
+
+def plan_delta(cur_plan: EplbPlan,
+               new_plan: EplbPlan) -> List[Tuple[int, int]]:
+    """``(dst_slot, src_slot)`` moves turning ``cur_plan`` into
+    ``new_plan``.  The source is the CURRENT canonical replica of the
+    expert the destination slot will hold — valid for the whole
+    migration because staging only reads the (immutable) serving
+    weights; unchanged slots produce no move."""
+    moves: List[Tuple[int, int]] = []
+    for p, e in enumerate(new_plan.phys_to_logical):
+        if cur_plan.phys_to_logical[p] != e:
+            moves.append((p, int(cur_plan.replica_table[e, 0])))
+    return moves
+
+
+# ---------------------------------------------------------------------------
+# Load tracking
+# ---------------------------------------------------------------------------
+
+
 class LoadTracker:
     """Sliding-window per-expert token counts (the ``window_size`` /
-    ``step_interval`` knobs of the reference's eplb-config)."""
+    ``step_interval`` knobs of the reference's eplb-config).
+
+    The window counts ENGINE STEPS, not samples: each record carries the
+    number of steps it represents (1 on the classic path, K for a fused
+    K-round retire, ``record_interval`` when sampling), so sampling or
+    fused dispatch never silently widens the window.  Eviction is O(1)
+    amortized (deque).  Samples with a leading layer axis (``[Lm, ...,
+    k]``) additionally accumulate per-layer counts for per-layer plans;
+    ``load`` stays the layer-aggregated view."""
 
     def __init__(self, num_experts: int, window_size: int = 1000):
         self.num_experts = num_experts
         self.window_size = window_size
         self._counts = np.zeros(num_experts, np.int64)
-        self._history: List[np.ndarray] = []
+        self._layer_counts: Optional[np.ndarray] = None   # [Lm, E]
+        self._history: Deque[Tuple[int, np.ndarray,
+                                   Optional[np.ndarray]]] = \
+            collections.deque()
+        self._steps = 0                     # total steps in the window
 
-    def record(self, expert_ids: np.ndarray) -> None:
-        """Record one step's routed expert ids (any shape of int array)."""
-        step = np.bincount(np.asarray(expert_ids).reshape(-1),
-                           minlength=self.num_experts).astype(np.int64)
-        self._history.append(step)
-        self._counts += step
-        while len(self._history) > self.window_size:
-            self._counts -= self._history.pop(0)
+    def record(self, expert_ids: np.ndarray, steps: int = 1) -> None:
+        """Record routed expert ids covering ``steps`` engine steps.
+
+        ``expert_ids`` with ndim >= 3 is layer-leading (``[Lm, ..., k]``,
+        the model's ``collect_routed`` stack) and feeds per-layer counts;
+        flatter shapes count aggregate-only."""
+        ids = np.asarray(expert_ids)
+        E = self.num_experts
+        flat = np.bincount(ids.reshape(-1), minlength=E).astype(np.int64)
+        layer = None
+        if ids.ndim >= 3 and ids.shape[0] > 0:
+            Lm = ids.shape[0]
+            off = (np.arange(Lm, dtype=np.int64)[:, None]
+                   * E + ids.reshape(Lm, -1))
+            layer = np.bincount(off.reshape(-1),
+                                minlength=Lm * E).astype(np.int64)
+            layer = layer.reshape(Lm, E)
+            if self._layer_counts is None \
+                    or self._layer_counts.shape[0] != Lm:
+                self._layer_counts = np.zeros((Lm, E), np.int64)
+            self._layer_counts += layer
+        self._history.append((max(1, int(steps)), flat, layer))
+        self._counts += flat
+        self._steps += max(1, int(steps))
+        while self._steps > self.window_size and len(self._history) > 1:
+            n, old_flat, old_layer = self._history.popleft()
+            self._steps -= n
+            self._counts -= old_flat
+            if old_layer is not None and self._layer_counts is not None \
+                    and self._layer_counts.shape == old_layer.shape:
+                self._layer_counts -= old_layer
 
     @property
     def load(self) -> np.ndarray:
         return self._counts.astype(np.float64)
+
+    @property
+    def layer_load(self) -> Optional[np.ndarray]:
+        """[Lm, E] per-layer load, or None before any layer-resolved
+        sample arrived."""
+        if self._layer_counts is None:
+            return None
+        return self._layer_counts.astype(np.float64)
 
     def imbalance(self) -> float:
         """max/mean per-expert load (1.0 = perfectly even)."""
@@ -162,33 +301,81 @@ def _expert_major_keys(moe_layers: Dict[str, Any]) -> List[str]:
 @dataclasses.dataclass
 class EplbConfig:
     """Engine-facing knobs mirroring the reference's ``--eplb-config``
-    (decode.yaml:79,100-104)."""
+    (decode.yaml:79,100-104).  ``imbalance_threshold`` / ``move_budget``
+    default to the env knobs (``LLMD_EPLB_IMBALANCE_THRESHOLD`` /
+    ``LLMD_EPLB_MOVE_BUDGET``, docs/ENVVARS.md) when unset."""
     num_redundant_experts: int = 0       # 0 -> auto: pad E to ep multiple + ep
     window_size: int = 1000
     step_interval: int = 3000            # engine steps between rebalances
     record_interval: int = 1             # sample routed ids every N steps
+    imbalance_threshold: Optional[float] = None   # hysteresis gate (None=env)
+    move_budget: Optional[int] = None    # slot copies staged per tick (None=env)
+    min_delta_slots: int = 1             # suppress plans moving fewer slots
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "EplbConfig":
         d = d or {}
+        thr = d.get("imbalance_threshold")
+        budget = d.get("move_budget")
         return cls(
             num_redundant_experts=int(d.get("num_redundant_experts", 0)),
             window_size=int(d.get("window_size", 1000)),
             step_interval=int(d.get("step_interval", 3000)),
-            record_interval=int(d.get("record_interval", 1)))
+            record_interval=int(d.get("record_interval", 1)),
+            imbalance_threshold=None if thr is None else float(thr),
+            move_budget=None if budget is None else int(budget),
+            min_delta_slots=int(d.get("min_delta_slots", 1)))
+
+
+# ---------------------------------------------------------------------------
+# Live migration state
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Migration:
+    """One in-flight placement change: target per-layer plans, the move
+    queue still to stage, and the spare slab being built."""
+    plans: List[EplbPlan]                      # target plan per layer
+    moves: Deque[Tuple[int, int, int]]         # (layer, dst_slot, src_slot)
+    total_moves: int
+    staged: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    staged_bytes: int = 0
+    started_step: int = 0
+    span: Any = None                           # eplb.migrate trace span
+
+
+_STAGE_FN = None
+
+
+def _stage_fn():
+    """Jitted slab update (compiled once per array shape): scatter the
+    gathered source rows of this tick's moves into the spare slab.  Both
+    gather and scatter are device-side; the call returns as soon as the
+    work is DISPATCHED — the host never waits on the copy."""
+    global _STAGE_FN
+    if _STAGE_FN is None:
+        import jax
+
+        def update(buf, cur, lyr, dst, src):
+            return buf.at[lyr, dst].set(cur[lyr, src])
+
+        _STAGE_FN = jax.jit(update)
+    return _STAGE_FN
 
 
 class EplbController:
     """Serving-path EPLB: installs the physical expert table into a MoE
-    model's params, records routed logical ids, and applies rebalances as
-    on-device gathers (no logical-weight copy is kept: every logical expert
-    always has >= 1 physical replica, so a new placement is a permutation
-    gather of the current physical weights).
+    model's params, records routed logical ids, and applies placement
+    changes as live migrations (no logical-weight copy is kept: every
+    logical expert always has >= 1 physical replica, so any new placement
+    is reachable by slot-to-slot copies of current physical weights).
 
-    One plan is shared by all MoE layers (load is aggregated across layers);
-    per-layer plans are a straightforward extension — the replica tables
-    are already stacked per layer for the scan.
-    """
+    Plans are per MoE layer (the replica tables are stacked per layer
+    for the model's scan); one move budget is amortized across layers.
+    ``metrics`` (utils.metrics.EngineMetrics) and ``tracer``
+    (utils.tracing.Tracer) are optional observability sinks the engine
+    wires after construction."""
 
     def __init__(self, num_experts: int, ep: int, config: EplbConfig) -> None:
         self.E = num_experts
@@ -215,23 +402,54 @@ class EplbController:
         # Static replica-table width: an expert with c replicas consumes
         # c - 1 redundant slots, so c <= r + 1 (and > ep adds nothing).
         self.max_r = min(ep, r + 1)
-        self.plan = plan_placement(np.ones(num_experts), r, ep)
+        self.plans: List[EplbPlan] = [
+            plan_placement(np.ones(num_experts), r, ep)]
+        self.n_layers = 1               # install() sets the real count
         self.tracker = LoadTracker(num_experts, config.window_size)
-        self.num_rebalances = 0
+        self.imbalance_threshold = (
+            config.imbalance_threshold
+            if config.imbalance_threshold is not None
+            else env_float("LLMD_EPLB_IMBALANCE_THRESHOLD", 1.0))
+        self.move_budget = max(1, (
+            config.move_budget if config.move_budget is not None
+            else env_int("LLMD_EPLB_MOVE_BUDGET", 64)))
+        self.num_rebalances = 0         # completed migrations (flips)
+        self.num_suppressed = 0         # plans skipped by hysteresis/min-delta
+        self.migrated_bytes = 0
+        self.last_flip_stall_s = 0.0
+        self.metrics = None             # EngineMetrics (engine wires it)
+        self.tracer = None              # llmd-trace Tracer (engine wires it)
+        self._migration: Optional[_Migration] = None
         self._last_rebalance_step = 0
+        self._last_record_step = 0
+
+    @property
+    def plan(self) -> EplbPlan:
+        """First layer's plan (the whole table before any migration —
+        kept as the single-plan view for tools/tests)."""
+        return self.plans[0]
+
+    @property
+    def migrating(self) -> bool:
+        return self._migration is not None
 
     # ---------- param plumbing ----------
 
-    def _stacked_tables(self, n_layers: int):
+    def _stacked_tables(self, n_layers: int,
+                        plans: Optional[List[EplbPlan]] = None):
         import jax.numpy as jnp
-        rt = np.zeros((self.E, self.max_r), np.int32)
-        rt[:, :self.plan.replica_table.shape[1]] = self.plan.replica_table
-        for e in range(self.E):
-            rt[e, self.plan.num_replicas[e]:] = rt[e, 0]
-        return (
-            jnp.asarray(np.broadcast_to(rt, (n_layers, *rt.shape))),
-            jnp.asarray(np.broadcast_to(
-                self.plan.num_replicas, (n_layers, self.E))))
+        plans = self.plans if plans is None else plans
+        if len(plans) != n_layers:
+            plans = [plans[0]] * n_layers
+        rt = np.zeros((n_layers, self.E, self.max_r), np.int32)
+        nr = np.zeros((n_layers, self.E), np.int32)
+        for li, plan in enumerate(plans):
+            w = plan.replica_table.shape[1]
+            rt[li, :, :w] = plan.replica_table
+            for e in range(self.E):
+                rt[li, e, plan.num_replicas[e]:] = rt[li, e, 0]
+            nr[li] = plan.num_replicas
+        return jnp.asarray(rt), jnp.asarray(nr)
 
     def install(self, params: Dict[str, Any], mesh, sharding_rules) -> Dict[str, Any]:
         """Replace logical expert weights with the physical table.
@@ -245,7 +463,9 @@ class EplbController:
 
         ml = dict(params["moe_layers"])
         n_layers = ml["router"].shape[0]
-        phys = jax.numpy.asarray(self.plan.phys_to_logical)
+        self.n_layers = n_layers
+        self.plans = [self.plans[0]] * n_layers
+        phys = jax.numpy.asarray(self.plans[0].phys_to_logical)
         ep_sharding = NamedSharding(mesh, P(None, AXIS_EP))
         for name in _expert_major_keys(ml):
             ml[name] = jax.device_put(ml[name][:, phys], ep_sharding)
@@ -261,48 +481,202 @@ class EplbController:
 
     def on_step(self, routed_ids, step: int, params: Dict[str, Any],
                 mesh) -> Dict[str, Any]:
-        """Record this step's routed logical ids (sampled) and rebalance on
-        the interval.  Returns (possibly updated) params."""
+        """The per-retire-boundary EPLB tick: record this boundary's
+        routed logical ids, advance an in-flight migration by one staging
+        budget (or flip it), and start a new migration on the interval.
+        Returns (possibly updated) params; the flip is the ONLY point
+        where they change."""
         c = self.config
-        if step % c.record_interval == 0 and routed_ids is not None:
-            self.tracker.record(np.asarray(routed_ids))
         # Interval CROSSING, not modulo: fused multi-step decode advances
         # the step counter by K, which would skip `step % interval == 0`
-        # forever and silently disable rebalancing.
+        # forever and silently disable recording/rebalancing.
+        if routed_ids is not None \
+                and step - self._last_record_step >= c.record_interval:
+            self.tracker.record(np.asarray(routed_ids),
+                                steps=step - self._last_record_step)
+            self._last_record_step = step
+        imb = self.tracker.imbalance()
+        if self.metrics is not None:
+            self.metrics.eplb_imbalance.set(imb)
+        if self._migration is not None:
+            return self._migration_tick(params, mesh)
         if step - self._last_rebalance_step >= c.step_interval \
                 and self.tracker.load.sum() > 0:
             self._last_rebalance_step = step
-            params = self.rebalance(params, mesh)
+            if imb < self.imbalance_threshold:
+                # Hysteresis: already balanced enough — re-check next
+                # interval instead of churning weights for noise.
+                self.num_suppressed += 1
+                logger.debug("eplb: imbalance %.3f < threshold %.3f, "
+                             "skipping rebalance", imb,
+                             self.imbalance_threshold)
+            else:
+                self._begin_migration(step)
+                if self._migration is not None:
+                    params = self._migration_tick(params, mesh)
         return params
 
     def rebalance(self, params: Dict[str, Any], mesh) -> Dict[str, Any]:
+        """Plan + stage + flip in ONE call (the synchronous pre-live-
+        migration surface, kept for tools/tests; the serving loop uses
+        the incremental ticks in ``on_step``)."""
+        import jax
+        if self._migration is None:
+            self._begin_migration(self._last_rebalance_step)
+        if self._migration is None:          # suppressed: nothing to do
+            return params
+        while self._migration is not None:
+            params = self._migration_tick(params, mesh)
+            if self._migration is not None and not self._migration.moves:
+                for arr in self._migration.staged.values():
+                    jax.block_until_ready(arr)
+        return params
+
+    # ---------- migration machinery ----------
+
+    def _begin_migration(self, step: int) -> None:
+        """Plan per-layer targets from the observed (per-layer when
+        available) load, align each to its serving plan, and queue the
+        delta moves.  Suppresses when fewer than ``min_delta_slots``
+        slots would change."""
+        n_layers = self.n_layers
+        layer_load = self.tracker.layer_load
+        if layer_load is None or layer_load.shape[0] != n_layers:
+            layer_load = np.broadcast_to(
+                self.tracker.load, (n_layers, self.E))
+        targets: List[EplbPlan] = []
+        moves: Deque[Tuple[int, int, int]] = collections.deque()
+        for li in range(n_layers):
+            new = plan_placement(layer_load[li] + 1e-9,
+                                 self.num_redundant, self.ep)
+            aligned = align_plan(new, self.plans[li])
+            targets.append(aligned)
+            for dst, src in plan_delta(self.plans[li], aligned):
+                moves.append((li, dst, src))
+        if len(moves) < max(1, self.config.min_delta_slots):
+            # Min-delta suppression: an identity (or near-identity) plan
+            # performs zero moves and costs nothing.
+            if moves:
+                self.num_suppressed += 1
+            logger.debug("eplb: delta of %d move(s) below min %d, "
+                         "suppressed", len(moves),
+                         self.config.min_delta_slots)
+            return
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span(
+                "eplb.migrate", step=step, layers=n_layers,
+                moves=len(moves), budget=self.move_budget,
+                imbalance=round(self.tracker.imbalance(), 4))
+        self._migration = _Migration(
+            plans=targets, moves=moves, total_moves=len(moves),
+            started_step=step, span=span)
+        logger.info("EPLB migration started: %d slot move(s) over %d "
+                    "layer(s), budget %d/tick (imbalance %.2f)",
+                    len(moves), n_layers, self.move_budget,
+                    self.tracker.imbalance())
+
+    def _migration_tick(self, params: Dict[str, Any],
+                        mesh) -> Dict[str, Any]:
+        """One retire-boundary advance: stage up to ``move_budget`` moves
+        (async device copies), then flip once everything staged is ready.
+        NEVER host-blocks — an unready slab just defers the flip one
+        tick."""
+        m = self._migration
+        assert m is not None
+        if m.moves:
+            batch = [m.moves.popleft()
+                     for _ in range(min(self.move_budget, len(m.moves)))]
+            staged_bytes = self._stage(batch, params)
+            m.staged_bytes += staged_bytes
+            if self.metrics is not None:
+                self.metrics.eplb_migrated_bytes.inc(staged_bytes)
+            if m.span is not None:
+                m.span.add_event("stage", moves=len(batch),
+                                 bytes=staged_bytes, pending=len(m.moves))
+        if not m.moves:
+            if self._staged_ready(m):
+                return self._flip(params, mesh)
+            if m.span is not None:
+                m.span.add_event("flip.deferred")
+        return params
+
+    def _stage(self, batch: List[Tuple[int, int, int]],
+               params: Dict[str, Any]) -> int:
+        """Stage one batch of (layer, dst, src) slot copies into the
+        spare slab.  Sources always read the CURRENT serving weights
+        (immutable until the flip), so staged rows are consistent
+        regardless of retirement order.  Returns bytes staged."""
+        import jax.numpy as jnp
+        m = self._migration
+        assert m is not None
+        # Pad to the budget so the jitted update compiles once per array
+        # shape; the pad repeats the last move (an idempotent re-copy).
+        padded = batch + [batch[-1]] * (self.move_budget - len(batch))
+        lyr = jnp.asarray([b[0] for b in padded], jnp.int32)
+        dst = jnp.asarray([b[1] for b in padded], jnp.int32)
+        src = jnp.asarray([b[2] for b in padded], jnp.int32)
+        ml = params["moe_layers"]
+        fn = _stage_fn()
+        nbytes = 0
+        for name in _expert_major_keys(ml):
+            cur = ml[name]
+            buf = m.staged.get(name)
+            if buf is None:
+                buf = jnp.copy(cur)     # the spare slab (async alloc+copy)
+            m.staged[name] = fn(buf, cur, lyr, dst, src)
+            per_slot = cur.nbytes // (cur.shape[0] * cur.shape[1])
+            nbytes += per_slot * len(batch)
+        return nbytes
+
+    @staticmethod
+    def _staged_ready(m: _Migration) -> bool:
+        """True when every staged slab has retired on device —
+        ``jax.Array.is_ready`` is a non-blocking poll, so the serving
+        loop never waits on a weight copy."""
+        for arr in m.staged.values():
+            ready = getattr(arr, "is_ready", None)
+            if ready is not None and not ready():
+                return False
+        return True
+
+    def _flip(self, params: Dict[str, Any], mesh) -> Dict[str, Any]:
+        """Atomically swap in the staged weights and the new stacked
+        tables: one params-dict rebuild at a retire boundary.  An
+        in-flight dispatch closed over the OLD dict and keeps its
+        consistent table+weights pair; the next dispatch sees the new
+        pair.  Host-blocked time here is the stall metric (~0: reference
+        swaps plus an async device_put of two small tables)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         from llm_d_tpu.parallel.mesh import AXIS_EP
 
-        new_plan = plan_placement(
-            self.tracker.load + 1e-9, self.num_redundant, self.ep)
-        if np.array_equal(new_plan.phys_to_logical,
-                          self.plan.phys_to_logical):
-            return params
-        # New physical slot p holds logical e = new.phys_to_logical[p];
-        # source it from the CURRENT canonical replica of e: one on-device
-        # permutation gather, re-placed with the EP sharding.
-        src = self.plan.replica_table[new_plan.phys_to_logical, 0]
-        src_dev = jax.numpy.asarray(src)
-        ep_sharding = NamedSharding(mesh, P(None, AXIS_EP))
+        m = self._migration
+        assert m is not None
+        t0 = time.monotonic()
         ml = dict(params["moe_layers"])
-        for name in _expert_major_keys(ml):
-            ml[name] = jax.device_put(ml[name][:, src_dev], ep_sharding)
-        self.plan = new_plan
-        n_layers = ml["router"].shape[0]
-        rt, nr = self._stacked_tables(n_layers)
+        for name, arr in m.staged.items():
+            ml[name] = arr
+        self.plans = list(m.plans)
+        rt, nr = self._stacked_tables(self.n_layers)
         repl = NamedSharding(mesh, P())
         ml["replica_table"] = jax.device_put(rt, repl)
         ml["num_replicas"] = jax.device_put(nr, repl)
-        self.num_rebalances += 1
-        logger.info("EPLB rebalance #%d applied (imbalance %.2f)",
-                    self.num_rebalances, self.tracker.imbalance())
         out = dict(params)
         out["moe_layers"] = ml
+        stall = time.monotonic() - t0
+        self.num_rebalances += 1
+        self.migrated_bytes += m.staged_bytes
+        self.last_flip_stall_s = stall
+        if self.metrics is not None:
+            self.metrics.eplb_migrations.inc()
+            self.metrics.eplb_migration_stall.observe(stall)
+        if m.span is not None:
+            m.span.add_event("flip", stall_s=round(stall, 6))
+            m.span.end(moves=m.total_moves, bytes=m.staged_bytes)
+        self._migration = None
+        logger.info("EPLB migration #%d flipped: %d move(s), %d bytes, "
+                    "stall %.3f ms (imbalance %.2f)",
+                    self.num_rebalances, m.total_moves, m.staged_bytes,
+                    stall * 1e3, self.tracker.imbalance())
         return out

@@ -33,7 +33,7 @@ from aiohttp import web
 
 from llm_d_tpu.server import stream_resume
 from llm_d_tpu.utils import tracing
-from llm_d_tpu.utils.config import env_choice, env_int
+from llm_d_tpu.utils.config import env_choice, env_float, env_int
 from llm_d_tpu.utils.faultinject import FaultInjected, get_injector
 from llm_d_tpu.utils.hashing import hash_token_blocks
 from llm_d_tpu.utils.lifecycle import (
@@ -74,6 +74,13 @@ class SimConfig:
         prefill_chunk: Optional[int] = None,
         step_prefill_token_ms: float = 0.0,
         num_scheduler_steps: int = 1,
+        eplb_skew: float = 0.0,
+        eplb_mode: str = "online",
+        eplb_num_experts: int = 64,
+        eplb_ep: int = 8,
+        eplb_step_interval: int = 64,
+        eplb_move_budget: Optional[int] = None,
+        eplb_imbalance_threshold: Optional[float] = None,
     ) -> None:
         self.model = model
         self.ttft_ms = ttft_ms
@@ -103,6 +110,24 @@ class SimConfig:
         # jitter amortized, exactly the shape the real pipeline produces.
         # 1 = classic per-step timing (byte-identical to round 15).
         self.num_scheduler_steps = num_scheduler_steps
+        # Live-EPLB mirror (round 17): under a Zipf(eplb_skew) routing
+        # popularity, the hottest EP shard serializes the dispatch, so a
+        # decode step stretches by the hot-shard overhang of the ACTIVE
+        # placement.  eplb_mode="static" keeps the uniform initial
+        # placement forever; "online" re-plans at eplb_step_interval with
+        # the REAL delta planner (parallel.eplb) and converges after
+        # ceil(moves / move_budget) background-staging steps with zero
+        # stall.  eplb_skew = 0 keeps timing byte-identical (mirror off);
+        # move_budget / imbalance_threshold = None resolve the engine's
+        # LLMD_EPLB_MOVE_BUDGET / LLMD_EPLB_IMBALANCE_THRESHOLD knobs so
+        # a chaos fleet flips modes with one environment.
+        self.eplb_skew = eplb_skew
+        self.eplb_mode = eplb_mode
+        self.eplb_num_experts = eplb_num_experts
+        self.eplb_ep = eplb_ep
+        self.eplb_step_interval = eplb_step_interval
+        self.eplb_move_budget = eplb_move_budget
+        self.eplb_imbalance_threshold = eplb_imbalance_threshold
 
 
 class InferenceSimulator:
@@ -162,6 +187,23 @@ class InferenceSimulator:
         self.step_prefill_token_ms = max(
             0.0, float(config.step_prefill_token_ms))
         self.num_scheduler_steps = max(1, int(config.num_scheduler_steps))
+        # Live-EPLB mirror (round 17; see SimConfig): placement state is
+        # built lazily from the real planner, env knobs resolved here.
+        self.eplb_skew = max(0.0, float(config.eplb_skew))
+        self.eplb_mode = str(config.eplb_mode)
+        self.eplb_num_experts = max(1, int(config.eplb_num_experts))
+        self.eplb_ep = max(1, int(config.eplb_ep))
+        self.eplb_step_interval = max(1, int(config.eplb_step_interval))
+        budget = config.eplb_move_budget
+        if budget is None:
+            budget = env_int("LLMD_EPLB_MOVE_BUDGET", 64)
+        self.eplb_move_budget = max(1, int(budget))
+        thr = config.eplb_imbalance_threshold
+        if thr is None:
+            thr = env_float("LLMD_EPLB_IMBALANCE_THRESHOLD", 1.0)
+        self.eplb_imbalance_threshold = float(thr)
+        self._eplb_steps = 0           # decode steps charged so far
+        self._eplb_state: Optional[Dict[str, Any]] = None
         self._prefill_inflight = 0
         self._running = 0
         self._waiting = 0
@@ -333,6 +375,90 @@ class InferenceSimulator:
                  else self._UNCHUNKED_TOKENS)
         return self._prefill_inflight * chunk * self.step_prefill_token_ms
 
+    def _eplb_model(self) -> Optional[Dict[str, Any]]:
+        """Lazily build the EPLB placement cost model from the REAL
+        planner (parallel.eplb is pure numpy at plan level): the Zipf
+        popularity, the hot-shard overhang of the uniform initial
+        placement vs. the load-proportional one, and how many
+        budget-limited staging steps the online migration needs."""
+        if self.eplb_skew <= 0.0:
+            return None
+        if self._eplb_state is None:
+            import numpy as np
+            from llm_d_tpu.parallel.eplb import (
+                align_plan, plan_delta, plan_placement)
+            E, ep = self.eplb_num_experts, self.eplb_ep
+            r = (-E) % ep + ep
+            load = np.arange(1, E + 1, dtype=np.float64) ** -self.eplb_skew
+
+            def shard_imbalance(plan):
+                per_replica = load / plan.num_replicas
+                shard = np.zeros(ep)
+                for p, e in enumerate(plan.phys_to_logical):
+                    shard[p // plan.slots_per_shard] += per_replica[e]
+                return float(shard.max() / shard.mean())
+
+            initial = plan_placement(np.ones(E), r, ep)
+            expert_imb = float(load.max() / load.mean())
+            balanced = align_plan(plan_placement(load, r, ep), initial)
+            moves = len(plan_delta(initial, balanced))
+            stage_steps = -(-moves // self.eplb_move_budget)
+            migrates = (self.eplb_mode == "online"
+                        and expert_imb >= self.eplb_imbalance_threshold
+                        and moves > 0)
+            self._eplb_state = {
+                "initial_imbalance": shard_imbalance(initial),
+                "balanced_imbalance": shard_imbalance(balanced),
+                "expert_imbalance": expert_imb,
+                "moves": moves,
+                "stage_steps": stage_steps,
+                # Staging overlaps decode, so the old (skewed) cost
+                # applies until the flip; the flip itself is free.
+                "flip_step": (self.eplb_step_interval + stage_steps
+                              if migrates else None),
+            }
+            self.metrics.eplb_imbalance.set(
+                self._eplb_state["initial_imbalance"])
+        return self._eplb_state
+
+    def _eplb_step_extra_ms(self) -> float:
+        """Per-step latency surcharge of serving a Zipf-skewed routing
+        mix on the ACTIVE expert placement (round 17).
+
+        Pure function of (config, decode-step counter): 0 when the
+        mirror is off; otherwise ``tpot_ms`` scaled by the hot-shard
+        overhang (max/mean - 1).  Static placement pays the skewed
+        overhang forever; online EPLB pays it only until the migration
+        flips (interval + budget-limited staging steps), then the
+        balanced overhang — the steady-state step-time win the bench
+        measures, with no stall spike at the flip."""
+        st = self._eplb_model()
+        if st is None:
+            return 0.0
+        flip = st["flip_step"]
+        if flip is not None and self._eplb_steps >= flip:
+            if not st.get("flipped"):
+                st["flipped"] = True
+                self.metrics.eplb_migrations.inc()
+                self.metrics.eplb_migration_stall.observe(0.0)
+                self.metrics.eplb_imbalance.set(st["balanced_imbalance"])
+            imb = st["balanced_imbalance"]
+        else:
+            imb = st["initial_imbalance"]
+        return self.config.tpot_ms * max(0.0, imb - 1.0)
+
+    def eplb_report(self) -> Optional[Dict[str, Any]]:
+        """Cost-model summary for bench extras / cluster projections."""
+        st = self._eplb_model()
+        if st is None:
+            return None
+        out = dict(st)
+        out.update(mode=self.eplb_mode, skew=self.eplb_skew,
+                   move_budget=self.eplb_move_budget,
+                   step_interval=self.eplb_step_interval,
+                   decode_steps=self._eplb_steps)
+        return out
+
     async def stream_tokens(self, ticket: Dict[str, Any]):
         """Yields (token_index, token_text) at the simulated rate for an
         admitted ticket; releases the slot + blocks on exit.  A deadline
@@ -444,7 +570,9 @@ class InferenceSimulator:
                     self.metrics.spec_accepted_tokens.inc(
                         step_starts[i] - 1)
                 if emitted > 0 and (not step_starts or i in step_starts):
-                    step_ms = c.tpot_ms + self._mixed_step_extra_ms()
+                    step_ms = (c.tpot_ms + self._mixed_step_extra_ms()
+                               + self._eplb_step_extra_ms())
+                    self._eplb_steps += 1
                     pending_ms += step_ms
                     pending_steps += 1
                     if pending_steps >= self.num_scheduler_steps:
@@ -811,6 +939,16 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="fused-multistep mirror: sim steps per host "
                         "dispatch (latency charged in N-step bursts, "
                         "TPOT jitter amortized; 1 = per-step timing)")
+    p.add_argument("--eplb-skew", type=float, default=0.0,
+                   help="live-EPLB mirror: Zipf exponent of the routing "
+                        "popularity; decode steps stretch by the "
+                        "hot-shard overhang of the active placement "
+                        "(0 = off, timing unchanged)")
+    p.add_argument("--eplb-mode", choices=("online", "static"),
+                   default="online",
+                   help="online = migrate to the balanced placement at "
+                        "the step interval (budgeted staging, zero "
+                        "stall); static = keep the uniform placement")
     args = p.parse_args(argv)
 
     cfg = SimConfig(
@@ -821,7 +959,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         spec_acceptance=args.spec_acceptance,
         prefill_chunk=args.prefill_chunk,
         step_prefill_token_ms=args.step_prefill_token_ms,
-        num_scheduler_steps=args.num_scheduler_steps)
+        num_scheduler_steps=args.num_scheduler_steps,
+        eplb_skew=args.eplb_skew, eplb_mode=args.eplb_mode)
     logging.basicConfig(level=logging.INFO)
     web.run_app(build_sim_server(cfg).build_app(),
                 host=args.host, port=args.port)

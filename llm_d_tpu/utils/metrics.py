@@ -78,6 +78,16 @@ FEATURE_DISABLED_METRIC = "llmd_tpu:engine_feature_disabled_total"
 # dashboard proof that host round-trips per decoded token dropped.
 ENGINE_DISPATCH_METRIC = "llmd_tpu:engine_dispatch_total"
 ENGINE_STEP_METRIC = "llmd_tpu:engine_steps_total"
+# Live EPLB (round 17, online expert migration): the window imbalance
+# (max/mean per-expert load; 1.0 = even), completed migrations (atomic
+# table+weight flips), slot-weight bytes staged in the background, and
+# the host-blocked time at each flip.  Stall ≈ 0 is the tentpole claim —
+# staging is async device-to-device copy overlapped with decode, the
+# flip is a params-dict reference swap gated on slab readiness.
+EPLB_IMBALANCE_METRIC = "llmd_tpu:eplb_imbalance"
+EPLB_MIGRATIONS_METRIC = "llmd_tpu:eplb_migrations_total"
+EPLB_MIGRATED_BYTES_METRIC = "llmd_tpu:eplb_migrated_bytes_total"
+EPLB_MIGRATION_STALL_METRIC = "llmd_tpu:eplb_migration_stall_seconds"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -241,6 +251,23 @@ class EngineMetrics:
             ENGINE_STEP_METRIC,
             "Engine rounds retired (a fused-multistep dispatch retires "
             "N at once).")
+        # Live EPLB (see the EPLB_* constants above).
+        self.eplb_imbalance = gauge(
+            EPLB_IMBALANCE_METRIC,
+            "Windowed per-expert load imbalance (max/mean; 1.0 = even) "
+            "driving the migration hysteresis gate.")
+        self.eplb_migrations = counter(
+            EPLB_MIGRATIONS_METRIC,
+            "Completed live expert migrations (atomic table+weight "
+            "flips).")
+        self.eplb_migrated_bytes = counter(
+            EPLB_MIGRATED_BYTES_METRIC,
+            "Expert-slot weight bytes staged by background migration "
+            "copies (incl. int8 sibling planes).")
+        self.eplb_migration_stall = histo(
+            EPLB_MIGRATION_STALL_METRIC,
+            "Host-blocked seconds at a migration flip (≈0: staging is "
+            "async; the flip is a reference swap).")
 
     def observe_phase(self, phase: str, criticality: str,
                       seconds: float) -> None:

@@ -72,6 +72,12 @@ python -m pytest tests/test_mixed_fusion.py -q
 # step/dispatch amortization counters, LLMD_SPEC_STRICT refusing a
 # degraded boot, and chaos resume from a kill MID N-round dispatch).
 python -m pytest tests/test_everything_on.py -q
+# Live-EPLB contract fail-fast (round 17: delta-plan migration — budget
+# and hysteresis invariants, atomic double-buffered flip with exact
+# post-flip weights, byte-identical greedy AND seeded parity across a
+# mid-stream migration, and a chaos kill landing mid-staging leaving
+# the serving table entirely old and the KV pool leak-free).
+python -m pytest tests/test_eplb.py tests/test_eplb_integration.py -q
 python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_lifecycle.py --ignore=tests/test_kv_quant.py \
     --ignore=tests/test_mla_quant.py \
@@ -81,4 +87,6 @@ python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_spec_decode.py \
     --ignore=tests/test_mixed_fusion.py \
     --ignore=tests/test_everything_on.py \
+    --ignore=tests/test_eplb.py \
+    --ignore=tests/test_eplb_integration.py \
     --ignore=tests/test_tracing.py

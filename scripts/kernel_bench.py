@@ -37,7 +37,8 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 # The --a2a CPU smoke needs a multi-device mesh; the virtual-device flag
 # must land before JAX initializes its backend (same mechanism as
 # tests/conftest.py).
-if "--a2a" in sys.argv and "--interpret" in sys.argv:
+if (("--a2a" in sys.argv or "--eplb" in sys.argv)
+        and "--interpret" in sys.argv):
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (
@@ -640,6 +641,135 @@ def run_mixed(args) -> dict:
     return doc
 
 
+# ---------------------------------------------------------------------------
+# Live-EPLB migration sweep (round 17): the migration ENGINE itself,
+# isolated from serving — a skew x move-budget grid over the delta
+# planner + double-buffered stager + atomic flip.  Each point builds a
+# fresh controller on real device arrays, dominates the load window with
+# a Zipf(skew) routed trace (popularity rolled per layer so per-layer
+# plans genuinely differ), then drives ``_begin_migration`` +
+# ``_migration_tick`` to convergence: moves queued, ticks-to-converge,
+# bytes staged, flip stall, and the shard imbalance the migration
+# actually bought.  This is how LLMD_EPLB_MOVE_BUDGET gets re-derived on
+# a chip (staging bandwidth vs. ticks-to-converge); --interpret runs
+# tiny shapes on CPU so tier-1 exercises the full machinery
+# (timings flagged invalid).
+# ---------------------------------------------------------------------------
+
+def run_eplb(args) -> dict:
+    import numpy as np
+    from llm_d_tpu.parallel.eplb import EplbConfig, EplbController
+    from llm_d_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    if args.interpret:
+        E, Lm, D, ep = 8, 2, 64, 4
+        skews = [0.8, 1.2]
+        budgets = [1, 4]
+        tokens = 2048
+    else:
+        E, Lm, D, ep = 64, 4, 65536, 8      # 256 KiB/plane/slot (f32)
+        skews = [0.6, 1.2, 2.0]
+        budgets = [4, 16, 64]
+        tokens = 1 << 16
+    ndev = 1
+    for n in range(min(ep, len(jax.devices())), 0, -1):
+        if (2 * E) % n == 0:                 # P = E + E redundant slots
+            ndev = n
+            break
+    mesh = make_mesh(MeshConfig(tp=ndev), jax.devices()[:ndev])
+
+    def fake_params(rng):
+        ml = {"router": rng.standard_normal((Lm, 4, E)).astype(np.float32)}
+        for name in ("w_gate", "w_up", "w_down"):
+            ml[name] = rng.standard_normal((Lm, E, D)).astype(np.float32)
+        # int8 sibling planes ride every move with their scales.
+        ml["w_up_q"] = rng.integers(-127, 127, (Lm, E, D)).astype(np.int8)
+        ml["w_up_s"] = rng.random((Lm, E, 1)).astype(np.float32)
+        return {"moe_layers": ml}
+
+    def shard_imbalance(plans, layer_load):
+        vals = []
+        for li, plan in enumerate(plans):
+            per_rep = layer_load[li] / plan.num_replicas
+            shard = np.zeros(ep)
+            for slot, e in enumerate(plan.phys_to_logical):
+                shard[slot // plan.slots_per_shard] += per_rep[e]
+            vals.append(shard.max() / max(shard.mean(), 1e-12))
+        return round(float(np.mean(vals)), 4)
+
+    points = []
+    for skew in skews:
+        pop = np.arange(1, E + 1, dtype=np.float64) ** -float(skew)
+        for budget in budgets:
+            rng = np.random.default_rng(1234)
+            ctrl = EplbController(E, ep, EplbConfig.from_dict({
+                "num_redundant_experts": E,
+                "window_size": 100,
+                "step_interval": 1,
+                "imbalance_threshold": 0.0,
+                "move_budget": budget,
+            }))
+            raw = fake_params(rng)
+            logical = {k: np.asarray(v)
+                       for k, v in raw["moe_layers"].items()}
+            params = ctrl.install(raw, mesh, None)
+            ids = np.stack([rng.choice(E, size=(tokens, 2),
+                                       p=np.roll(pop, li) / pop.sum())
+                            for li in range(Lm)])
+            ctrl.tracker.record(ids)
+            before_plans = list(ctrl.plans)
+            load = ctrl.tracker.layer_load
+
+            t0 = time.perf_counter()
+            ctrl._begin_migration(0)
+            moves = (ctrl._migration.total_moves if ctrl.migrating else 0)
+            ticks = 0
+            while ctrl.migrating:
+                params = ctrl._migration_tick(params, mesh)
+                ticks += 1
+                if ctrl.migrating and not ctrl._migration.moves:
+                    # Staging drained but slabs still in flight: wait so
+                    # the next tick flips (the serving loop just keeps
+                    # decoding here — this sweep wants convergence time).
+                    for arr in ctrl._migration.staged.values():
+                        jax.block_until_ready(arr)
+            wall_ms = 1e3 * (time.perf_counter() - t0)
+
+            # Post-flip weights must equal the logical gather exactly —
+            # the sweep doubles as a device-array consistency check.
+            ok = all(
+                np.array_equal(
+                    np.asarray(params["moe_layers"][name][li]),
+                    logical[name][li][plan.phys_to_logical])
+                for name in ("w_gate", "w_up_q", "w_up_s")
+                for li, plan in enumerate(ctrl.plans))
+            points.append({
+                "skew": skew,
+                "budget": budget,
+                "moves": moves,
+                "ticks": ticks,
+                "staged_mb": round(ctrl.migrated_bytes / 1e6, 3),
+                "converge_wall_ms": round(wall_ms, 3),
+                "flip_stall_ms": round(1e3 * ctrl.last_flip_stall_s, 3),
+                "imbalance_before": shard_imbalance(before_plans, load),
+                "imbalance_after": shard_imbalance(ctrl.plans, load),
+                "weights_consistent": ok,
+            })
+
+    doc = {
+        "mode": "eplb",
+        "backend": jax.default_backend(),
+        "interpret": args.interpret,
+        "timings_valid": not args.interpret,
+        "shapes": {"E": E, "layers": Lm, "plane_elems": D, "ep": ep,
+                   "devices": ndev, "trace_tokens": tokens},
+        "points": points,
+    }
+    if not all(p["weights_consistent"] for p in points):
+        doc["error"] = "post-flip weights diverged from the logical gather"
+    return doc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interpret", action="store_true",
@@ -673,6 +803,12 @@ def main(argv=None) -> int:
                          "decode/verify tokens vs the same work as two "
                          "programs) instead of the MoE kernel family; "
                          "--t-sweep sets the chunk sizes")
+    ap.add_argument("--eplb", action="store_true",
+                    help="run the live-EPLB skew x move-budget migration "
+                         "sweep (delta planning, double-buffered staging, "
+                         "atomic flip) on real device arrays instead of "
+                         "the MoE kernel family; --interpret runs tiny "
+                         "shapes on CPU (full-machinery smoke)")
     ap.add_argument("--multistep", type=lambda s: [int(n) for n in
                                                    s.split(",") if n],
                     default=None,
@@ -708,11 +844,13 @@ def main(argv=None) -> int:
                     help="also write the JSON document to this path")
     args = ap.parse_args(argv)
 
-    if args.paged or args.mla or args.a2a or args.spec or args.mixed:
+    if (args.paged or args.mla or args.a2a or args.spec or args.mixed
+            or args.eplb):
         doc = (run_paged(args) if args.paged
                else run_mla(args) if args.mla
                else run_spec(args) if args.spec
-               else run_mixed(args) if args.mixed else run_a2a(args))
+               else run_mixed(args) if args.mixed
+               else run_eplb(args) if args.eplb else run_a2a(args))
         text = json.dumps(doc)
         print(text)
         if args.out:

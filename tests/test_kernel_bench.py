@@ -144,6 +144,35 @@ def test_kernel_bench_spec_sweep_interpret(tmp_path, capsys):
     assert doc["recommended_k"] in (1, 2)
 
 
+def test_kernel_bench_eplb_sweep_interpret(tmp_path, capsys):
+    """--eplb: the skew x move-budget migration sweep drives the REAL
+    live-migration machinery (delta planner, double-buffered staging,
+    atomic flip) on the multi-device CPU mesh: a tighter budget costs
+    more ticks for the same moves, the flip cuts the measured shard
+    imbalance, and the post-flip device weights match the logical
+    gather exactly."""
+    mod = _kernel_bench()
+    out = tmp_path / "eplb.json"
+    rc = mod.main(["--eplb", "--interpret", "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc == json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["mode"] == "eplb" and doc["timings_valid"] is False
+    by_key = {(p["skew"], p["budget"]): p for p in doc["points"]}
+    assert len(by_key) == 4
+    for p in doc["points"]:
+        assert p["weights_consistent"] is True
+        assert p["moves"] > 0 and p["staged_mb"] > 0
+        # Budget-limited staging: ticks >= ceil(moves/budget), plus the
+        # final flip tick.
+        assert p["ticks"] >= -(-p["moves"] // p["budget"])
+        assert p["imbalance_after"] <= p["imbalance_before"]
+    for skew in (0.8, 1.2):
+        tight, loose = by_key[(skew, 1)], by_key[(skew, 4)]
+        assert tight["moves"] == loose["moves"]
+        assert tight["ticks"] > loose["ticks"]
+
+
 def test_kernel_bench_mixed_sweep_interpret(tmp_path, capsys):
     """--mixed: the mixed-round fusion sweep times ONE streamed program
     over the combined prefill-chunk + decode/verify population against
