@@ -119,14 +119,21 @@ class Collector:
             *(self._scrape(ep) for ep in self.endpoints)))
 
     async def _scrape(self, endpoint: str) -> ReplicaSample:
-        s = ReplicaSample()
+        """HTTP transport only; parse/diff lives in :meth:`ingest` so
+        the cluster simulator's sockets-free collector reuses the exact
+        cumulative-diff logic against in-process replica registries."""
         try:
             async with self._session.get(
                     f"http://{endpoint}/metrics") as resp:
                 resp.raise_for_status()
-                m = parse_prometheus_text(await resp.text())
+                text = await resp.text()
         except Exception:
-            return s
+            return ReplicaSample()
+        return self.ingest(endpoint, text)
+
+    def ingest(self, endpoint: str, text: str) -> ReplicaSample:
+        s = ReplicaSample()
+        m = parse_prometheus_text(text)
         s.ready = True
         s.kv_usage = m.get("vllm:kv_cache_usage_perc", 0.0)
         s.num_waiting = m.get("vllm:num_requests_waiting", 0.0)

@@ -345,20 +345,32 @@ class Datastore:
             return_exceptions=True)
 
     async def _scrape(self, e: EndpointState) -> None:
+        """HTTP transport only; parse/apply lives in
+        :meth:`apply_scrape_text` so transports can differ (the cluster
+        simulator's :class:`~llm_d_tpu.sim.cluster.SimDatastore` reads
+        in-process replica registries through the same apply path —
+        readiness, drain detection, and gauge extraction never fork)."""
         try:
             async with self._session.get(f"{e.url}/metrics") as resp:
                 # A 5xx with a parseable-but-empty body would score as a
                 # zero-load (= most attractive) endpoint; only 200 is ready.
                 resp.raise_for_status()
                 text = await resp.text()
-            m = parse_prometheus_text(text)
-            e.num_waiting = m.get("vllm:num_requests_waiting", 0.0)
-            e.num_running = m.get("vllm:num_requests_running", 0.0)
-            e.kv_usage = m.get(self.kv_usage_metric, 0.0)
-            e.draining = m.get(DRAIN_STATE_METRIC, 0.0) >= 1.0
-            e.ready = True
-            e.scrape_error = None
-            e.last_scrape = time.monotonic()
         except Exception as exc:  # endpoint down -> not a candidate
-            e.ready = False
-            e.scrape_error = str(exc)
+            self.apply_scrape_error(e, exc)
+            return
+        self.apply_scrape_text(e, text)
+
+    def apply_scrape_text(self, e: EndpointState, text: str) -> None:
+        m = parse_prometheus_text(text)
+        e.num_waiting = m.get("vllm:num_requests_waiting", 0.0)
+        e.num_running = m.get("vllm:num_requests_running", 0.0)
+        e.kv_usage = m.get(self.kv_usage_metric, 0.0)
+        e.draining = m.get(DRAIN_STATE_METRIC, 0.0) >= 1.0
+        e.ready = True
+        e.scrape_error = None
+        e.last_scrape = time.monotonic()
+
+    def apply_scrape_error(self, e: EndpointState, exc: Exception) -> None:
+        e.ready = False
+        e.scrape_error = str(exc)

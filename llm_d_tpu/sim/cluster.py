@@ -1,0 +1,1491 @@
+"""Discrete-event cluster simulator: the chaos testbed at fleet scale.
+
+``tests/test_chaos.py`` tops out at 8 in-process replicas behind one
+gateway because every replica is an aiohttp server on a real socket and
+every sleep burns wall clock.  This module removes both limits while
+keeping the REAL control plane in the loop:
+
+  * **Virtual clock.**  A custom event loop (:class:`VirtualClockEventLoop`)
+    advances time by jumping straight to the next scheduled callback —
+    a 10-minute diurnal scenario with hundreds of replicas finishes in
+    CPU seconds.  During a run the module-level ``time.time`` /
+    ``time.monotonic`` / ``time.perf_counter`` are patched to the
+    virtual clock, so every component that stamps time — breaker
+    open-windows, ``parse_deadline``, vLLM latency histograms, the WVA
+    collector's cumulative diffs, llmd-trace spans — runs on simulated
+    time without a single code fork.  Single-threaded by construction;
+    the patch is restored in a ``finally``.
+
+  * **Real control plane.**  Scheduling is the real
+    :class:`~llm_d_tpu.epp.scheduler.EppScheduler` plugin pipeline over
+    the real :class:`~llm_d_tpu.epp.datastore.Datastore` (scrape parse,
+    drain detection and readiness via :meth:`Datastore.apply_scrape_text`
+    — only the HTTP transport is replaced by an in-process registry
+    read).  Admission is the real
+    :class:`~llm_d_tpu.epp.service.FlowControl`; endpoint health is the
+    real :class:`~llm_d_tpu.epp.datastore.EndpointBreaker`; autoscaling
+    is the real :meth:`~llm_d_tpu.autoscaler.wva.VariantAutoscaler.decide`
+    fed by the real :meth:`~llm_d_tpu.autoscaler.wva.Collector.ingest`
+    diff logic.  Replicas are real
+    :class:`~llm_d_tpu.sim.simulator.InferenceSimulator` instances — the
+    same admission/stream/resume semantics the socket-level chaos suite
+    exercises.
+
+  * **Cluster fault plane.**  Correlated failure domains are scheduled
+    :class:`FaultEvent` timelines ("minute 3: zone-b dies; minute 5 it
+    comes back") plus three new ``LLMD_FAULTS`` points —
+    ``cluster.partition`` (keyed ``src->dst``), ``cluster.zone_kill``
+    (keyed by zone) and ``cluster.straggler`` (keyed by address) — so
+    the seeded injector grammar drives correlated faults too.
+
+  * **Trace-driven multi-tenant workload.**  Per-tenant Poisson arrival
+    processes under a diurnal envelope (thinning), per-tenant prefix
+    pools, chat / long-context RAG / multi-turn agentic kinds, and an
+    explicit trace-record replay mode (the format
+    ``scripts/generate_load.py --trace-out`` emits).
+
+  * **Per-tenant SLO scoreboard.**  p50/p99 TTFT and TPOT per SLO class
+    per tenant, deadline-miss / stream-break / shed counts, and the
+    ``llmd_tpu:slo_attainment_ratio{criticality,tenant_bucket}`` gauge —
+    the machine-checked judge for every scenario.  Same seed => the
+    JSON report is byte-identical (seeded RNGs, virtual timestamps,
+    ``json.dumps(sort_keys=True)``).
+
+Scale honesty: each simulated token is a Python-level event, so cost is
+O(total tokens), not O(virtual seconds).  Hundreds of replicas and tens
+of thousands of tokens run in seconds; the ≥100-replica long scenarios
+are marked ``slow`` in the test tier.  See docs/cluster-sim.md for the
+scenario-file format and the fault-timeline grammar.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import hashlib
+import heapq
+import json
+import logging
+import math
+import random
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from llm_d_tpu.autoscaler.wva import (
+    Collector,
+    ReplicaSample,
+    VariantAutoscaler,
+    VariantAutoscalingSpec,
+)
+from llm_d_tpu.epp.config import parse_config
+from llm_d_tpu.epp.datastore import Datastore, EndpointBreaker, EndpointState
+from llm_d_tpu.epp.plugins import RequestCtx
+from llm_d_tpu.epp.scheduler import EppScheduler
+from llm_d_tpu.epp.service import FlowControl
+from llm_d_tpu.server import stream_resume
+from llm_d_tpu.server.stream_resume import resume_policy
+from llm_d_tpu.sim.simulator import (
+    DeadlineExceeded,
+    InferenceSimulator,
+    SimConfig,
+)
+from llm_d_tpu.utils import tracing
+from llm_d_tpu.utils.config import env_float, env_int
+from llm_d_tpu.utils.faultinject import (
+    FaultInjected,
+    FaultInjector,
+    get_injector,
+    install,
+    reset as faultinject_reset,
+)
+from llm_d_tpu.utils.lifecycle import (
+    CRITICALITY_HEADER,
+    CRITICALITY_SHEDDABLE,
+    CRITICALITY_STANDARD,
+    DEADLINE_MS_HEADER,
+    PREFILLER_HEADER,
+    REQUEST_ID_HEADER,
+    TENANT_HEADER,
+    parse_tenant,
+    remaining_s,
+)
+from llm_d_tpu.utils.metrics import ClusterMetrics, EppMetrics
+
+logger = logging.getLogger(__name__)
+
+# Fixed virtual epoch: time.time() during a run is EPOCH0 + virtual
+# seconds, so absolute deadlines and span timestamps are seed-stable.
+EPOCH0 = 1_700_000_000.0
+
+
+# ---------------------------------------------------------------------------
+# Virtual clock
+# ---------------------------------------------------------------------------
+
+
+class VirtualClockEventLoop(asyncio.SelectorEventLoop):
+    """Event loop whose clock jumps to the next timer instead of waiting.
+
+    With no sockets in the simulation, ALL progress comes from the ready
+    queue and the timer heap; when the ready queue drains, wall-waiting
+    for the earliest timer is pure waste — so the loop sets its clock to
+    that timer's deadline and lets the base implementation run it with a
+    zero select timeout.  ``time()`` is the virtual clock, which every
+    ``call_later`` / ``asyncio.sleep`` in the process inherits.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.virtual_now = 0.0
+
+    def time(self) -> float:
+        return self.virtual_now
+
+    def _run_once(self) -> None:
+        # Strip cancelled timers off the heap head exactly the way the
+        # base loop does, so the jump target is a LIVE deadline (a
+        # cancelled wait_for timeout must not drag the clock forward).
+        while self._scheduled and self._scheduled[0]._cancelled:
+            self._timer_cancelled_count -= 1
+            handle = heapq.heappop(self._scheduled)
+            handle._scheduled = False
+        if not self._ready and self._scheduled:
+            self.virtual_now = max(self.virtual_now,
+                                   self._scheduled[0]._when)
+        elif not self._ready and not self._scheduled and not self._stopping:
+            # No sockets => nothing external can ever wake us: an empty
+            # loop that isn't stopping is a deadlocked scenario (e.g. a
+            # semaphore nobody releases).  Fail fast instead of hanging.
+            raise RuntimeError(
+                "cluster sim deadlock: no ready callbacks and no timers")
+        super()._run_once()
+
+
+class _VirtualTimePatch:
+    """Patch ``time.time``/``monotonic``/``perf_counter`` to the loop's
+    virtual clock for the duration of a run (single-threaded; restored
+    in ``__exit__``)."""
+
+    def __init__(self, loop: VirtualClockEventLoop) -> None:
+        self.loop = loop
+        self._saved: Dict[str, Any] = {}
+
+    def __enter__(self) -> "_VirtualTimePatch":
+        loop = self.loop
+        self._saved = {"time": time.time, "monotonic": time.monotonic,
+                       "perf_counter": time.perf_counter}
+        time.time = lambda: EPOCH0 + loop.virtual_now
+        time.monotonic = lambda: loop.virtual_now
+        time.perf_counter = lambda: loop.virtual_now
+        return self
+
+    def __exit__(self, *exc) -> None:
+        time.time = self._saved["time"]
+        time.monotonic = self._saved["monotonic"]
+        time.perf_counter = self._saved["perf_counter"]
+
+
+# ---------------------------------------------------------------------------
+# Fleet: replicas, transport, fault plane
+# ---------------------------------------------------------------------------
+
+
+class LinkDown(Exception):
+    """A virtual network link refused the hop (partition / link fault)."""
+
+
+class ReplicaUnavailable(Exception):
+    """Target replica is dead, draining, still booting, or removed."""
+
+
+GATEWAY_NODE = "gateway"
+
+
+class ClusterReplica:
+    """One simulated model-server replica plus its cluster-level facts."""
+
+    def __init__(self, address: str, zone: str, role: str,
+                 config: SimConfig, scalable: bool = False) -> None:
+        self.address = address
+        self.zone = zone
+        self.role = role
+        self.scalable = scalable          # autoscaler may remove it
+        self._base_ttft_ms = config.ttft_ms
+        self._base_tpot_ms = config.tpot_ms
+        self.straggle_factor = 1.0
+        self.sim = InferenceSimulator(config)
+        self.alive = True
+
+    @property
+    def servable(self) -> bool:
+        return (self.alive and self.sim.model_loaded
+                and not self.sim.dead and not self.sim.draining)
+
+    def kill(self) -> None:
+        self.alive = False
+        self.sim.dead = True              # every in-flight stream breaks
+
+    def restore(self, restart_delay_s: float) -> None:
+        """Replace the dead engine with a fresh one at the same address
+        (the pod restarted); ready again after ``restart_delay_s``."""
+        cfg = self.sim.config
+        cfg.startup_delay_s = restart_delay_s
+        self.sim = InferenceSimulator(cfg)
+        self.apply_straggle(self.straggle_factor)
+        self.alive = True
+
+    def apply_straggle(self, factor: float) -> None:
+        self.straggle_factor = max(1.0, float(factor))
+        self.sim.config.ttft_ms = self._base_ttft_ms * self.straggle_factor
+        self.sim.config.tpot_ms = self._base_tpot_ms * self.straggle_factor
+
+
+def _match_selector(sel: str, zone: str, role: str, address: str) -> bool:
+    """Fault-plane selector: ``*`` | ``zone:<z>`` | ``role:<r>`` |
+    ``addr:<host:port>`` | a bare address."""
+    if sel == "*":
+        return True
+    if sel.startswith("zone:"):
+        return zone == sel[5:]
+    if sel.startswith("role:"):
+        want = sel[5:]
+        return role == want or (role == "both" and want in
+                                ("prefill", "decode")) \
+            or (want == GATEWAY_NODE and role == GATEWAY_NODE)
+    if sel.startswith("addr:"):
+        return address == sel[5:]
+    return address == sel
+
+
+class ClusterTransport:
+    """Every cross-node hop goes through here: static partitions from
+    the fault plane compose with seeded ``cluster.partition`` injector
+    rules, so a scenario can partition deterministically by timeline OR
+    probabilistically by ``LLMD_FAULTS``."""
+
+    def __init__(self, cluster: "ClusterSim") -> None:
+        self.cluster = cluster
+        # Active partitions: list of (src_selector, dst_selector); a hop
+        # matching either direction of a bidirectional entry is blocked.
+        self.partitions: List[Tuple[str, str]] = []
+
+    def _node(self, name: str) -> Tuple[str, str, str]:
+        if name == GATEWAY_NODE:
+            return (GATEWAY_NODE, GATEWAY_NODE, GATEWAY_NODE)
+        r = self.cluster.replicas.get(name)
+        if r is None:
+            return ("", "", name)
+        return (r.zone, r.role, r.address)
+
+    def blocked(self, src: str, dst: str) -> bool:
+        """Static partition check only — cheap enough for the per-token
+        relay loop (the injector point fires once per hop in
+        :meth:`check`, not per token)."""
+        szone, srole, saddr = self._node(src)
+        dzone, drole, daddr = self._node(dst)
+        for a, b in self.partitions:
+            if (_match_selector(a, szone, srole, saddr)
+                    and _match_selector(b, dzone, drole, daddr)):
+                return True
+            if (_match_selector(a, dzone, drole, daddr)
+                    and _match_selector(b, szone, srole, saddr)):
+                return True
+        return False
+
+    async def check(self, src: str, dst: str) -> None:
+        """Raise :class:`LinkDown` if the hop src->dst cannot be made."""
+        try:
+            await get_injector().acheck("cluster.partition",
+                                        key=f"{src}->{dst}")
+        except FaultInjected as exc:
+            tracing.trace_event("cluster", "link.down", src=src, dst=dst,
+                                cause="injected")
+            raise LinkDown(f"{src}->{dst} (injected)") from exc
+        if self.blocked(src, dst):
+            tracing.trace_event("cluster", "link.down", src=src, dst=dst,
+                                cause="partition")
+            raise LinkDown(f"{src}->{dst} (partitioned)")
+
+    async def fetch_metrics(self, src: str, dst: str) -> str:
+        """The scrape transport: what GET /metrics would have returned."""
+        await self.check(src, dst)
+        r = self.cluster.replicas.get(dst)
+        if r is None or not r.alive:
+            raise ReplicaUnavailable(f"{dst} down")
+        if not r.sim.model_loaded:
+            raise ReplicaUnavailable(f"{dst} booting")
+        return r.sim.metrics.render().decode()
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One scheduled entry of a scenario's fault timeline.
+
+    Kinds (see docs/cluster-sim.md for the full grammar):
+
+      ``zone_kill``       target = zone name; every replica dies at once
+      ``zone_restore``    target = zone name; pods restart, ready after
+                          ``restart_delay_s`` (params)
+      ``flap``            zone_kill now + zone_restore ``down_s`` later
+      ``replica_kill``    target = address
+      ``replica_restore`` target = address
+      ``partition``       target = "<src_sel>|<dst_sel>" (bidirectional)
+      ``partition_heal``  target = same string as the partition
+      ``straggler``       target = address; params ``factor`` multiplies
+                          its step times
+      ``straggler_clear`` target = address
+      ``drain``           target = address; graceful drain
+    """
+    at_s: float
+    kind: str
+    target: str = ""
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultEvent":
+        known = {"at_s", "kind", "target"}
+        return cls(at_s=float(d["at_s"]), kind=str(d["kind"]),
+                   target=str(d.get("target", "")),
+                   params={k: v for k, v in d.items() if k not in known})
+
+
+class ClusterFaultPlane:
+    """Applies the scheduled fault timeline and polls the seeded
+    injector's correlated points each tick."""
+
+    def __init__(self, cluster: "ClusterSim",
+                 timeline: List[FaultEvent], tick_s: float = 1.0) -> None:
+        self.cluster = cluster
+        self.timeline = sorted(timeline, key=lambda e: e.at_s)
+        self.tick_s = tick_s
+        self._next = 0
+        self.applied: List[Tuple[float, str, str]] = []
+
+    async def run(self, until_s: float) -> None:
+        loop = asyncio.get_running_loop()
+        while loop.time() <= until_s:
+            self.tick(loop.time())
+            await asyncio.sleep(self.tick_s)
+
+    def tick(self, now: float) -> None:
+        while self._next < len(self.timeline) \
+                and self.timeline[self._next].at_s <= now:
+            self.apply(self.timeline[self._next])
+            self._next += 1
+        self._poll_injected_zone_kills(now)
+        self._poll_injected_stragglers(now)
+
+    def _poll_injected_zone_kills(self, now: float) -> None:
+        """``LLMD_FAULTS="cluster.zone_kill:p=...,match=zone-b"`` drives
+        correlated gang kills through the same seeded grammar every
+        other fault point uses."""
+        for zone in self.cluster.zones():
+            try:
+                get_injector().check("cluster.zone_kill", key=zone)
+            except FaultInjected:
+                tracing.trace_event("cluster", "zone.kill", zone=zone,
+                                    cause="injected", at=now)
+                self.apply(FaultEvent(at_s=now, kind="zone_kill",
+                                      target=zone))
+
+    def _poll_injected_stragglers(self, now: float) -> None:
+        factor = env_float("LLMD_SIM_STRAGGLER_FACTOR", 4.0)
+        for addr, r in list(self.cluster.replicas.items()):
+            if r.straggle_factor > 1.0:
+                continue
+            try:
+                get_injector().check("cluster.straggler", key=addr)
+            except FaultInjected:
+                tracing.trace_event("cluster", "replica.straggler",
+                                    address=addr, factor=factor, at=now)
+                r.apply_straggle(factor)
+
+    def apply(self, ev: FaultEvent) -> None:
+        c = self.cluster
+        now = asyncio.get_running_loop().time()
+        self.applied.append((now, ev.kind, ev.target))
+        tracing.trace_event("cluster", f"fault.timeline.{ev.kind}",
+                            target=ev.target, at=now)
+        if ev.kind == "zone_kill":
+            for r in c.in_zone(ev.target):
+                r.kill()
+                c.dead_log.add(r.address)
+        elif ev.kind == "zone_restore":
+            delay = float(ev.params.get("restart_delay_s", 5.0))
+            for r in c.in_zone(ev.target):
+                if not r.alive:
+                    r.restore(delay)
+                    c.track(c.spawn_boot(r))
+        elif ev.kind == "flap":
+            for r in c.in_zone(ev.target):
+                r.kill()
+                c.dead_log.add(r.address)
+            self._schedule_restore(ev, float(ev.params.get("down_s", 30.0)))
+        elif ev.kind == "replica_kill":
+            r = c.replicas.get(ev.target)
+            if r is not None:
+                r.kill()
+                c.dead_log.add(r.address)
+        elif ev.kind == "replica_restore":
+            r = c.replicas.get(ev.target)
+            if r is not None and not r.alive:
+                r.restore(float(ev.params.get("restart_delay_s", 5.0)))
+                c.track(c.spawn_boot(r))
+        elif ev.kind == "partition":
+            sel = ev.target.split("|", 1)
+            if len(sel) == 2:
+                c.transport.partitions.append((sel[0], sel[1]))
+        elif ev.kind == "partition_heal":
+            sel = ev.target.split("|", 1)
+            if len(sel) == 2 and tuple(sel) in c.transport.partitions:
+                c.transport.partitions.remove((sel[0], sel[1]))
+        elif ev.kind == "straggler":
+            r = c.replicas.get(ev.target)
+            if r is not None:
+                r.apply_straggle(float(ev.params.get(
+                    "factor", env_float("LLMD_SIM_STRAGGLER_FACTOR", 4.0))))
+        elif ev.kind == "straggler_clear":
+            r = c.replicas.get(ev.target)
+            if r is not None:
+                r.apply_straggle(1.0)
+        elif ev.kind == "drain":
+            r = c.replicas.get(ev.target)
+            if r is not None:
+                r.sim.set_draining()
+        else:
+            logger.warning("fault timeline: unknown kind %r", ev.kind)
+
+    def _schedule_restore(self, ev: FaultEvent, down_s: float) -> None:
+        # flap's restore is a synthesized timeline entry merged in order.
+        restore = FaultEvent(at_s=ev.at_s + down_s, kind="zone_restore",
+                             target=ev.target, params=dict(ev.params))
+        tail = self.timeline[self._next:]
+        tail.append(restore)
+        tail.sort(key=lambda e: e.at_s)
+        self.timeline = self.timeline[:self._next] + tail
+
+
+# ---------------------------------------------------------------------------
+# Sockets-free transports over the real scrape/collect logic
+# ---------------------------------------------------------------------------
+
+
+class SimDatastore(Datastore):
+    """Real Datastore (parse, readiness, drain detection, breaker) with
+    the HTTP transport swapped for an in-process registry read."""
+
+    def __init__(self, cluster: "ClusterSim",
+                 scrape_interval_s: float = 1.0,
+                 breaker: Optional[EndpointBreaker] = None) -> None:
+        super().__init__([], scrape_interval_s=scrape_interval_s,
+                         breaker=breaker)
+        self.cluster = cluster
+
+    async def _scrape(self, e: EndpointState) -> None:
+        try:
+            text = await self.cluster.transport.fetch_metrics(
+                GATEWAY_NODE, e.address)
+        except Exception as exc:
+            self.apply_scrape_error(e, exc)
+            return
+        self.apply_scrape_text(e, text)
+
+
+class SimCollector(Collector):
+    """Real WVA collector (cumulative histogram diffing) with the HTTP
+    transport swapped for the cluster transport."""
+
+    def __init__(self, cluster: "ClusterSim") -> None:
+        super().__init__([])
+        self.cluster = cluster
+
+    async def collect(self) -> List[ReplicaSample]:
+        self.endpoints = sorted(self.cluster.replicas)
+        for gone in set(self._prev) - set(self.endpoints):
+            del self._prev[gone]
+        return list(await asyncio.gather(
+            *(self._scrape(ep) for ep in self.endpoints)))
+
+    async def _scrape(self, endpoint: str) -> ReplicaSample:
+        try:
+            text = await self.cluster.transport.fetch_metrics(
+                GATEWAY_NODE, endpoint)
+        except Exception:
+            return ReplicaSample()
+        return self.ingest(endpoint, text)
+
+
+# ---------------------------------------------------------------------------
+# Scenario
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SloTarget:
+    ttft_ms: float
+    tpot_ms: float
+
+
+DEFAULT_SLOS: Dict[str, SloTarget] = {
+    "critical": SloTarget(ttft_ms=2000.0, tpot_ms=40.0),
+    "standard": SloTarget(ttft_ms=4000.0, tpot_ms=80.0),
+    "sheddable": SloTarget(ttft_ms=8000.0, tpot_ms=160.0),
+}
+
+
+@dataclasses.dataclass
+class TenantSpec:
+    """One tenant's arrival process + workload shape.
+
+    ``kind``: ``chat`` (short prompts), ``rag`` (long prompts that cross
+    the PD threshold) or ``agent`` (multi-turn sessions whose prompt
+    grows each turn — the prefix-cache stress shape).  ``criticality``
+    is a class name or a ``{class: weight}`` mix.
+    """
+    name: str
+    qps: float = 1.0
+    kind: str = "chat"
+    criticality: Any = CRITICALITY_STANDARD
+    prefix_groups: int = 4
+    prefix_len: int = 8
+    max_tokens: int = 16
+    deadline_ms: Optional[float] = None
+    turns: int = 3                      # agent kind: requests per session
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TenantSpec":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass
+class ReplicaGroup:
+    zone: str
+    count: int
+    role: str = "both"
+    ttft_ms: float = 50.0
+    tpot_ms: float = 10.0
+    max_num_seqs: int = 64
+    num_blocks: int = 1024
+    startup_delay_s: float = 0.0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaGroup":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 16
+    target_saturation: float = 0.6
+    mode: str = "capacity"
+    interval_s: float = 15.0
+    zone: str = "zone-a"               # where scale-up replicas land
+    startup_delay_s: float = 5.0
+    slo_ttft_ms: float = 2000.0
+    slo_tpot_ms: float = 40.0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AutoscalePolicy":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass
+class Diurnal:
+    """Sinusoidal burst envelope: arrival rate swings between
+    ``low`` x qps (trough) and ``high`` x qps (peak) over ``period_s``."""
+    period_s: float = 600.0
+    low: float = 0.2
+    high: float = 1.0
+
+    def level(self, t: float) -> float:
+        phase = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / self.period_s))
+        return self.low + (self.high - self.low) * phase
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Diurnal":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass
+class Scenario:
+    """Everything one chaos run needs; loadable from a JSON dict (see
+    docs/cluster-sim.md for the authoring guide)."""
+    name: str = "scenario"
+    seed: int = 0
+    duration_s: float = 60.0
+    model: str = "sim-model"
+    replicas: List[ReplicaGroup] = dataclasses.field(default_factory=list)
+    tenants: List[TenantSpec] = dataclasses.field(default_factory=list)
+    faults: List[FaultEvent] = dataclasses.field(default_factory=list)
+    # Extra seeded injector rules, the LLMD_FAULTS grammar verbatim.
+    llmd_faults: str = ""
+    diurnal: Optional[Diurnal] = None
+    autoscale: AutoscalePolicy = dataclasses.field(
+        default_factory=AutoscalePolicy)
+    # Explicit trace replay: records {at_s, tenant, prompt, max_tokens,
+    # criticality, deadline_ms} issued at their timestamps (composes
+    # with the generative tenants above).
+    trace: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    slos: Dict[str, SloTarget] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_SLOS))
+    pd_threshold: Optional[int] = None  # tokens; None = no PD disagg
+    scrape_interval_s: float = 1.0
+    fault_tick_s: float = 1.0
+    max_inflight: int = 256
+    max_queue: int = 512
+    queue_timeout_s: float = 30.0
+    retry_attempts: int = 2
+    breaker_failures: int = 3
+    breaker_open_s: float = 5.0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Scenario":
+        d = dict(d)
+        d["replicas"] = [ReplicaGroup.from_dict(g)
+                         for g in d.get("replicas", [])]
+        d["tenants"] = [TenantSpec.from_dict(t)
+                        for t in d.get("tenants", [])]
+        d["faults"] = [FaultEvent.from_dict(f) for f in d.get("faults", [])]
+        if d.get("diurnal"):
+            d["diurnal"] = Diurnal.from_dict(d["diurnal"])
+        if d.get("autoscale"):
+            d["autoscale"] = AutoscalePolicy.from_dict(d["autoscale"])
+        if d.get("slos"):
+            d["slos"] = {k: SloTarget(**v) for k, v in d["slos"].items()}
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+# ---------------------------------------------------------------------------
+# Scoreboard
+# ---------------------------------------------------------------------------
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    ordered = sorted(xs)
+    idx = max(0, min(len(ordered) - 1,
+                     int(math.ceil(q * len(ordered))) - 1))
+    return ordered[idx]
+
+
+def tenant_bucket(tenant: str, buckets: int) -> str:
+    """Stable (cross-process, cross-run) tenant -> bucket label."""
+    h = int(hashlib.sha256(tenant.encode()).hexdigest()[:8], 16)
+    return str(h % max(1, buckets))
+
+
+class _Cell:
+    __slots__ = ("requests", "ok", "attained", "ttft", "tpot",
+                 "deadline_miss", "stream_breaks", "resumes", "shed",
+                 "rejected", "no_endpoint", "prefill_fallback")
+
+    def __init__(self) -> None:
+        self.requests = 0
+        self.ok = 0
+        self.attained = 0
+        self.ttft: List[float] = []
+        self.tpot: List[float] = []
+        self.deadline_miss = 0
+        self.stream_breaks = 0
+        self.resumes: Dict[str, int] = {}
+        self.shed = 0
+        self.rejected = 0
+        self.no_endpoint = 0
+        self.prefill_fallback = 0
+
+
+class Scoreboard:
+    """Per-(tenant, SLO class) accumulation + the attainment judge.
+
+    Attainment = among requests that were ADMITTED (not shed/rejected
+    at the gate — shedding sheddables under overload is policy working,
+    not an SLO miss), the fraction that finished cleanly AND met both
+    class targets.  Deadline misses, stream breaks and mid-fleet
+    failures all land in the denominator.
+    """
+
+    def __init__(self, slos: Dict[str, SloTarget],
+                 buckets: Optional[int] = None) -> None:
+        self.slos = slos
+        self.buckets = (buckets if buckets is not None
+                        else env_int("LLMD_SIM_TENANT_BUCKETS", 8))
+        self.cells: Dict[Tuple[str, str], _Cell] = {}
+        self.metrics = ClusterMetrics()
+
+    def cell(self, tenant: str, crit: str) -> _Cell:
+        key = (tenant, crit)
+        c = self.cells.get(key)
+        if c is None:
+            c = self.cells[key] = _Cell()
+        return c
+
+    def record(self, tenant: str, crit: str, rec: Dict[str, Any]) -> None:
+        c = self.cell(tenant, crit)
+        c.requests += 1
+        outcome = rec.get("outcome", "ok")
+        if outcome in ("shed", "queue_full", "timeout"):
+            if outcome == "shed":
+                c.shed += 1
+            else:
+                c.rejected += 1
+            return
+        if outcome == "no_endpoint":
+            c.no_endpoint += 1
+            return
+        if rec.get("ttft_s") is not None:
+            c.ttft.append(rec["ttft_s"])
+        if rec.get("tpot_s") is not None:
+            c.tpot.append(rec["tpot_s"])
+        for out, n in (rec.get("resumes") or {}).items():
+            c.resumes[out] = c.resumes.get(out, 0) + n
+        if rec.get("prefill_fallback"):
+            c.prefill_fallback += 1
+        if outcome == "deadline":
+            c.deadline_miss += 1
+            return
+        if outcome == "break":
+            c.stream_breaks += 1
+            return
+        c.ok += 1
+        slo = self.slos.get(crit, DEFAULT_SLOS[CRITICALITY_STANDARD])
+        ttft_ok = (rec.get("ttft_s") is not None
+                   and rec["ttft_s"] * 1000.0 <= slo.ttft_ms)
+        tpot_ok = (rec.get("tpot_s") is None
+                   or rec["tpot_s"] * 1000.0 <= slo.tpot_ms)
+        if ttft_ok and tpot_ok:
+            c.attained += 1
+
+    def report(self) -> Dict[str, Any]:
+        tenants: Dict[str, Any] = {}
+        classes: Dict[str, _Cell] = {}
+        bucket_acc: Dict[Tuple[str, str], List[int]] = {}
+        for (tenant, crit), c in sorted(self.cells.items()):
+            row = {
+                "requests": c.requests,
+                "ok": c.ok,
+                "ttft_p50_ms": round(_percentile(c.ttft, 0.5) * 1e3, 3),
+                "ttft_p99_ms": round(_percentile(c.ttft, 0.99) * 1e3, 3),
+                "tpot_p50_ms": round(_percentile(c.tpot, 0.5) * 1e3, 3),
+                "tpot_p99_ms": round(_percentile(c.tpot, 0.99) * 1e3, 3),
+                "deadline_miss": c.deadline_miss,
+                "stream_breaks": c.stream_breaks,
+                "resumes": dict(sorted(c.resumes.items())),
+                "shed": c.shed,
+                "rejected": c.rejected,
+                "no_endpoint": c.no_endpoint,
+                "prefill_fallback": c.prefill_fallback,
+            }
+            admitted = c.requests - c.shed - c.rejected
+            attained = c.attained
+            row["attainment"] = round(attained / admitted, 6) \
+                if admitted else 1.0
+            tenants.setdefault(tenant, {})[crit] = row
+            agg = classes.setdefault(crit, _Cell())
+            agg.requests += c.requests
+            agg.ok += c.ok
+            agg.ttft.extend(c.ttft)
+            agg.tpot.extend(c.tpot)
+            agg.deadline_miss += c.deadline_miss
+            agg.stream_breaks += c.stream_breaks
+            agg.shed += c.shed
+            agg.rejected += c.rejected
+            agg.no_endpoint += c.no_endpoint
+            bkt = tenant_bucket(tenant, self.buckets)
+            acc = bucket_acc.setdefault((crit, bkt), [0, 0])
+            acc[0] += attained
+            acc[1] += admitted
+        class_rows = {}
+        for crit, agg in sorted(classes.items()):
+            class_rows[crit] = {
+                "requests": agg.requests,
+                "ok": agg.ok,
+                "ttft_p50_ms": round(_percentile(agg.ttft, 0.5) * 1e3, 3),
+                "ttft_p99_ms": round(_percentile(agg.ttft, 0.99) * 1e3, 3),
+                "tpot_p50_ms": round(_percentile(agg.tpot, 0.5) * 1e3, 3),
+                "tpot_p99_ms": round(_percentile(agg.tpot, 0.99) * 1e3, 3),
+                "deadline_miss": agg.deadline_miss,
+                "stream_breaks": agg.stream_breaks,
+                "shed": agg.shed,
+                "rejected": agg.rejected,
+                "no_endpoint": agg.no_endpoint,
+            }
+        attainment: Dict[str, Dict[str, float]] = {}
+        for (crit, bkt), (att, adm) in sorted(bucket_acc.items()):
+            ratio = round(att / adm, 6) if adm else 1.0
+            attainment.setdefault(crit, {})[bkt] = ratio
+            self.metrics.slo_attainment.labels(
+                criticality=crit, tenant_bucket=bkt).set(ratio)
+        return {"tenants": tenants, "classes": class_rows,
+                "attainment": attainment}
+
+
+# ---------------------------------------------------------------------------
+# Gateway: real flow control + real scheduler + in-process relay
+# ---------------------------------------------------------------------------
+
+
+class SimGateway:
+    """The gateway's admission/schedule/forward/relay path over the
+    virtual transport.  FlowControl, EppScheduler, the breaker and the
+    resume-policy knobs are the REAL objects; only the byte transport
+    (aiohttp request + SSE relay) is replaced by direct calls into the
+    target replica's :class:`InferenceSimulator`."""
+
+    def __init__(self, cluster: "ClusterSim", scheduler: EppScheduler,
+                 datastore: Datastore, metrics: EppMetrics,
+                 flow: FlowControl, retry_attempts: int = 2) -> None:
+        self.cluster = cluster
+        self.scheduler = scheduler
+        self.datastore = datastore
+        self.metrics = metrics
+        self.flow = flow
+        self.retry_attempts = retry_attempts
+        self.tracer = tracing.get_tracer("gateway")
+
+    async def handle(self, body: Dict[str, Any],
+                     in_headers: Dict[str, str]) -> Dict[str, Any]:
+        """One request end to end; returns the scoreboard record."""
+        t_arrival = asyncio.get_running_loop().time()
+        ctx = RequestCtx.from_request(body, in_headers)
+        tenant = parse_tenant(in_headers, body)
+        rec: Dict[str, Any] = {"tenant": tenant,
+                               "criticality": ctx.criticality,
+                               "outcome": "ok", "resumes": {},
+                               "ttft_s": None, "tpot_s": None,
+                               "tokens": 0}
+        span = self.tracer.start_span("gw.request",
+                                      request_id=ctx.request_id,
+                                      tenant=tenant,
+                                      criticality=ctx.criticality)
+        sheddable = (ctx.criticality == CRITICALITY_SHEDDABLE
+                     or ctx.priority < 0)
+        left = remaining_s(ctx.deadline_epoch)
+        verdict = await self.flow.acquire(sheddable, ctx.criticality,
+                                          max_wait_s=left)
+        if verdict != "ok":
+            if verdict == "saturated":
+                self.metrics.shed_total.inc()
+                rec["outcome"] = "shed"
+            else:
+                rec["outcome"] = verdict
+            span.end(outcome=rec["outcome"])
+            return rec
+        try:
+            await self._serve(ctx, rec, t_arrival, span)
+        finally:
+            self.flow.release()
+            span.end(outcome=rec["outcome"])
+        return rec
+
+    async def _serve(self, ctx: RequestCtx, rec: Dict[str, Any],
+                     t_arrival: float, span) -> None:
+        sim0 = next(iter(self.cluster.replicas.values()), None)
+        prompt_ids = (list(ctx.token_ids) if ctx.token_ids
+                      else (sim0.sim._tokenize(ctx.prompt_text)
+                            if sim0 is not None else []))
+        max_tokens = int(ctx.body.get("max_tokens", 16))
+        policy = resume_policy()
+        excluded: set = set()
+        offset = 0
+        resumes = 0
+        t_first: Optional[float] = None
+        t_last: Optional[float] = None
+        broke_at: Optional[float] = None
+        loop = asyncio.get_running_loop()
+        attempts = 1 + max(0, self.retry_attempts)
+        while True:
+            ctx.excluded_endpoints = set(excluded)
+            ctx.retry_attempt = resumes
+            result = self.scheduler.schedule(ctx)
+            primary = result.primary
+            if primary is None:
+                rec["outcome"] = "break" if offset else "no_endpoint"
+                if offset:
+                    self.metrics.stream_resume.labels(
+                        outcome=stream_resume.OUTCOME_FAILED).inc()
+                span.add_event("no_endpoint", offset=offset)
+                return
+            target = primary.address
+            replica = self.cluster.replicas.get(target)
+            if "prefill" in result.picks and result.picks["prefill"] \
+                    .address != target:
+                await self._prefill_hop(ctx, result, target,
+                                        prompt_ids, rec, span)
+            ticket = None
+            sim = replica.sim if replica is not None else None
+            try:
+                await self.cluster.transport.check(GATEWAY_NODE, target)
+                if replica is None or not replica.servable:
+                    raise ReplicaUnavailable(target)
+                ticket = await sim.admit(
+                    prompt_ids, max_tokens, ctx.deadline_epoch,
+                    ctx.criticality, start=offset, span=span)
+            except DeadlineExceeded:
+                rec["outcome"] = "deadline"
+                self.metrics.gateway_deadline_exceeded.labels(
+                    criticality=ctx.criticality).inc()
+                return
+            except (LinkDown, ReplicaUnavailable, FaultInjected):
+                # Pre-first-byte failure of this attempt: breaker +
+                # retry-on-alternate, nothing reached the client.
+                self.datastore.breaker.record_failure(target)
+                excluded.add(target)
+                if resumes >= max(attempts - 1, policy.max_attempts):
+                    rec["outcome"] = "break" if offset else "no_endpoint"
+                    return
+                resumes += 1
+                self.metrics.gateway_retries.labels(reason="connect").inc()
+                continue
+            gen = sim.stream_tokens(ticket)
+            try:
+                async for i, _word in gen:
+                    now = loop.time()
+                    if t_first is None:
+                        t_first = now
+                        rec["ttft_s"] = now - t_arrival
+                    if offset and broke_at is not None:
+                        outcome = (ticket.get("resume_src")
+                                   or stream_resume.OUTCOME_RECOMPUTED)
+                        rec["resumes"][outcome] = \
+                            rec["resumes"].get(outcome, 0) + 1
+                        self.metrics.stream_resume.labels(
+                            outcome=outcome).inc()
+                        self.metrics.request_recovery.observe(
+                            now - broke_at)
+                        broke_at = None
+                    offset = i + 1
+                    rec["tokens"] = offset
+                    t_last = now
+                    if self.cluster.transport.blocked(GATEWAY_NODE,
+                                                      target):
+                        raise LinkDown(f"{GATEWAY_NODE}->{target}")
+            except (RuntimeError, FaultInjected, LinkDown) as exc:
+                # Mid-stream death: journaled failover — resume on an
+                # alternate at the exact delivered offset.
+                span.add_event("stream.break", offset=offset,
+                               endpoint=target,
+                               cause=type(exc).__name__)
+                self.datastore.breaker.record_failure(target)
+                excluded.add(target)
+                if (not policy.enabled or sheddable_break(ctx)
+                        or resumes >= policy.max_attempts):
+                    rec["outcome"] = "break"
+                    self.metrics.stream_resume.labels(
+                        outcome=stream_resume.OUTCOME_FAILED).inc()
+                    return
+                resumes += 1
+                broke_at = loop.time()
+                self.metrics.gateway_retries.labels(reason="stream").inc()
+                continue
+            finally:
+                await gen.aclose()
+                if ticket is not None:
+                    sim.release_ticket(ticket)
+            # Clean finish.
+            self.datastore.breaker.record_success(target)
+            if ticket.get("expired"):
+                rec["outcome"] = "deadline"
+            if t_first is not None and t_last is not None \
+                    and rec["tokens"] > 1:
+                rec["tpot_s"] = (t_last - t_first) / (rec["tokens"] - 1)
+            return
+
+    async def _prefill_hop(self, ctx: RequestCtx, result, decode_addr: str,
+                           prompt_ids: List[int], rec: Dict[str, Any],
+                           span) -> None:
+        """Disaggregated prefill with ranked failover: try the hint
+        header's prefillers in order over the decode->prefill links; if
+        every one fails, the decode pod recomputes locally (slower TTFT,
+        NEVER a stream break)."""
+        header = result.headers.get(PREFILLER_HEADER, "")
+        decode_replica = self.cluster.replicas.get(decode_addr)
+        for addr in [a for a in header.split(",") if a]:
+            r = self.cluster.replicas.get(addr)
+            try:
+                await get_injector().acheck("sidecar.prefill", key=addr)
+                await self.cluster.transport.check(decode_addr, addr)
+                if r is None or not r.servable:
+                    raise ReplicaUnavailable(addr)
+            except (FaultInjected, LinkDown, ReplicaUnavailable):
+                span.add_event("prefill.failover", prefiller=addr)
+                self.datastore.breaker.record_failure(addr)
+                continue
+            # Remote prefill: charge the prefiller's prefill time, then
+            # the KV lands warm on the decode pod (its TTFT collapses to
+            # the prefix-hit path — the disaggregation win).
+            await asyncio.sleep(r.sim.config.ttft_ms / 1e3)
+            r.sim.metrics.prompt_tokens.inc(len(prompt_ids))
+            self.datastore.breaker.record_success(addr)
+            if decode_replica is not None:
+                decode_replica.sim._store_prefix(prompt_ids)
+            rec["prefiller"] = addr
+            span.add_event("prefill.done", prefiller=addr)
+            return
+        rec["prefill_fallback"] = True
+        span.add_event("prefill.fallback", decode=decode_addr)
+
+
+def sheddable_break(ctx: RequestCtx) -> bool:
+    """Sheddable streams are not worth a resume slot mid-incident."""
+    return ctx.criticality == CRITICALITY_SHEDDABLE
+
+
+# ---------------------------------------------------------------------------
+# Workload
+# ---------------------------------------------------------------------------
+
+_TAIL_WORDS = ("alpha", "bravo", "charlie", "delta", "echo", "foxtrot",
+               "golf", "hotel", "india", "juliett", "kilo", "lima")
+
+
+class Workload:
+    """Per-tenant arrival processes + trace replay, feeding the gateway
+    and the scoreboard."""
+
+    def __init__(self, scenario: Scenario, gateway: SimGateway,
+                 scoreboard: Scoreboard) -> None:
+        self.scenario = scenario
+        self.gateway = gateway
+        self.scoreboard = scoreboard
+        self.request_tasks: List[asyncio.Task] = []
+        self._seq = 0
+
+    def _mk_prompt(self, tenant: TenantSpec, rng: random.Random,
+                   session_tail: str = "") -> str:
+        g = rng.randrange(max(1, tenant.prefix_groups))
+        reps = tenant.prefix_len
+        if tenant.kind == "rag":
+            # Long-context: comfortably past any PD threshold (~4 chars
+            # per sim token).
+            thr = self.scenario.pd_threshold or 0
+            reps = max(tenant.prefix_len, (thr * 4) //
+                       max(1, len(f"{tenant.name} pool-{g} ")) + 1)
+        prefix = f"{tenant.name} pool-{g} " * reps
+        tail = " ".join(rng.choices(_TAIL_WORDS, k=4))
+        return prefix + session_tail + tail
+
+    def _crit(self, tenant: TenantSpec, rng: random.Random) -> str:
+        crit = tenant.criticality
+        if isinstance(crit, dict):
+            classes = sorted(crit)
+            weights = [float(crit[c]) for c in classes]
+            return rng.choices(classes, weights=weights)[0]
+        return str(crit)
+
+    def _submit(self, tenant_name: str, crit: str, prompt: str,
+                max_tokens: int, deadline_ms: Optional[float]) -> asyncio.Task:
+        self._seq += 1
+        body = {"model": self.scenario.model, "prompt": prompt,
+                "max_tokens": max_tokens, "stream": True}
+        headers = {CRITICALITY_HEADER: crit,
+                   TENANT_HEADER: tenant_name,
+                   REQUEST_ID_HEADER: f"{tenant_name}-{self._seq}"}
+        if deadline_ms is not None:
+            headers[DEADLINE_MS_HEADER] = str(deadline_ms)
+
+        async def one() -> None:
+            rec = await self.gateway.handle(body, headers)
+            self.scoreboard.record(rec["tenant"], rec["criticality"], rec)
+
+        task = asyncio.get_running_loop().create_task(one())
+        self.request_tasks.append(task)
+        return task
+
+    async def _tenant_loop(self, tenant: TenantSpec) -> None:
+        rng = random.Random(f"{self.scenario.seed}:{tenant.name}")
+        loop = asyncio.get_running_loop()
+        end = self.scenario.duration_s
+        diurnal = self.scenario.diurnal
+        peak = diurnal.high if diurnal else 1.0
+        rate = max(1e-6, tenant.qps * peak)
+        while True:
+            await asyncio.sleep(rng.expovariate(rate))
+            now = loop.time()
+            if now >= end:
+                return
+            if diurnal is not None \
+                    and rng.random() >= diurnal.level(now) / peak:
+                continue            # thinned: off-peak arrival rejected
+            crit = self._crit(tenant, rng)
+            if tenant.kind == "agent":
+                self._spawn_session(tenant, crit, rng)
+            else:
+                self._submit(tenant.name, crit,
+                             self._mk_prompt(tenant, rng),
+                             tenant.max_tokens, tenant.deadline_ms)
+
+    def _spawn_session(self, tenant: TenantSpec, crit: str,
+                       rng: random.Random) -> None:
+        turns = max(1, tenant.turns)
+        session_rng = random.Random(rng.random())
+
+        async def session() -> None:
+            tail = ""
+            for turn in range(turns):
+                prompt = self._mk_prompt(tenant, session_rng, tail)
+                task = self._submit(tenant.name, crit, prompt,
+                                    tenant.max_tokens, tenant.deadline_ms)
+                await task
+                tail += f"turn-{turn} "
+
+        t = asyncio.get_running_loop().create_task(session())
+        self.request_tasks.append(t)
+
+    async def _replay_trace(self) -> None:
+        loop = asyncio.get_running_loop()
+        for recd in sorted(self.scenario.trace,
+                           key=lambda r: float(r.get("at_s", 0.0))):
+            at = float(recd.get("at_s", 0.0))
+            delay = at - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            self._submit(str(recd.get("tenant", "-")),
+                         str(recd.get("criticality",
+                                      CRITICALITY_STANDARD)),
+                         str(recd.get("prompt", "replay")),
+                         int(recd.get("max_tokens", 16)),
+                         recd.get("deadline_ms"))
+
+    async def run(self) -> None:
+        gens = [asyncio.get_running_loop().create_task(
+            self._tenant_loop(t)) for t in self.scenario.tenants]
+        if self.scenario.trace:
+            gens.append(asyncio.get_running_loop().create_task(
+                self._replay_trace()))
+        await asyncio.gather(*gens)
+        # Let in-flight requests (and agent sessions spawning tails)
+        # finish; sessions append while we drain, so loop until stable.
+        while True:
+            pending = [t for t in self.request_tasks if not t.done()]
+            if not pending:
+                break
+            await asyncio.gather(*pending, return_exceptions=True)
+
+
+# ---------------------------------------------------------------------------
+# The cluster
+# ---------------------------------------------------------------------------
+
+
+class ClusterSim:
+    """Build a fleet from a scenario, run it on the virtual clock, and
+    return the scoreboard report (a plain sorted-keys dict)."""
+
+    def __init__(self, scenario: Scenario) -> None:
+        self.scenario = scenario
+        self.replicas: Dict[str, ClusterReplica] = {}
+        self.transport = ClusterTransport(self)
+        self.dead_log: set = set()
+        self.epp_metrics = EppMetrics()
+        breaker = EndpointBreaker(
+            failure_threshold=scenario.breaker_failures,
+            open_s=scenario.breaker_open_s, metrics=self.epp_metrics)
+        self.datastore = SimDatastore(
+            self, scrape_interval_s=scenario.scrape_interval_s,
+            breaker=breaker)
+        self.scheduler = EppScheduler(
+            parse_config(self._epp_yaml()), self.datastore,
+            metrics=self.epp_metrics)
+        self.flow = FlowControl(scenario.max_inflight, scenario.max_queue,
+                                scenario.queue_timeout_s, self.epp_metrics)
+        self.gateway = SimGateway(self, self.scheduler, self.datastore,
+                                  self.epp_metrics, self.flow,
+                                  retry_attempts=scenario.retry_attempts)
+        self.scoreboard = Scoreboard(scenario.slos)
+        self.fault_plane = ClusterFaultPlane(
+            self, scenario.faults, tick_s=scenario.fault_tick_s)
+        self.wva: Optional[VariantAutoscaler] = None
+        self.replicas_peak = 0
+        self._tasks: List[asyncio.Task] = []
+        self._next_index: Dict[str, int] = {}
+
+    # ---------- fleet plumbing ----------
+
+    def zones(self) -> List[str]:
+        return sorted({r.zone for r in self.replicas.values()})
+
+    def in_zone(self, zone: str) -> List[ClusterReplica]:
+        return [r for a, r in sorted(self.replicas.items())
+                if r.zone == zone]
+
+    def track(self, task: Optional[asyncio.Task]) -> None:
+        if task is not None:
+            self._tasks.append(task)
+
+    def spawn_boot(self, r: ClusterReplica) -> Optional[asyncio.Task]:
+        delay = r.sim.config.startup_delay_s
+        if delay <= 0:
+            r.sim.model_loaded = True
+            return None
+        sim = r.sim
+
+        async def boot() -> None:
+            await asyncio.sleep(delay)
+            sim.model_loaded = True
+
+        return asyncio.get_running_loop().create_task(boot())
+
+    def _add_replica(self, group: ReplicaGroup,
+                     scalable: bool = False) -> ClusterReplica:
+        n = self._next_index.get(group.zone, 0)
+        self._next_index[group.zone] = n + 1
+        address = f"{group.zone}-{n}:8200"
+        cfg = SimConfig(model=self.scenario.model, ttft_ms=group.ttft_ms,
+                        tpot_ms=group.tpot_ms,
+                        max_num_seqs=group.max_num_seqs,
+                        num_blocks=group.num_blocks,
+                        startup_delay_s=group.startup_delay_s,
+                        seed=self.scenario.seed * 100003 + n
+                        + len(self.replicas))
+        r = ClusterReplica(address, group.zone, group.role, cfg,
+                           scalable=scalable)
+        self.replicas[address] = r
+        self.replicas_peak = max(self.replicas_peak, len(self.replicas))
+        return r
+
+    def _remove_replica(self, address: str) -> None:
+        self.replicas.pop(address, None)
+        self._reconcile_datastore()
+
+    def _reconcile_datastore(self) -> None:
+        self.datastore.reconcile(
+            [(a, r.role) for a, r in sorted(self.replicas.items())])
+
+    def _epp_yaml(self) -> str:
+        if self.scenario.pd_threshold is None:
+            return """
+kind: EndpointPickerConfig
+plugins:
+- type: single-profile-handler
+- type: drain-filter
+- type: circuit-breaker-filter
+- type: queue-scorer
+- type: kv-cache-utilization-scorer
+- type: prefix-cache-scorer
+  parameters: {hashBlockSize: 64, lruCapacityPerServer: 31250}
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: drain-filter
+  - pluginRef: circuit-breaker-filter
+  - pluginRef: queue-scorer
+    weight: 2
+  - pluginRef: kv-cache-utilization-scorer
+    weight: 2
+  - pluginRef: prefix-cache-scorer
+    weight: 3
+  - pluginRef: max-score-picker
+"""
+        return f"""
+kind: EndpointPickerConfig
+plugins:
+- type: pd-profile-handler
+  parameters: {{threshold: {int(self.scenario.pd_threshold)}}}
+- type: prefill-header-handler
+- type: drain-filter
+- type: circuit-breaker-filter
+- type: queue-scorer
+- type: kv-cache-utilization-scorer
+- type: prefix-cache-scorer
+  parameters: {{hashBlockSize: 64, lruCapacityPerServer: 31250}}
+- type: max-score-picker
+schedulingProfiles:
+- name: prefill
+  plugins:
+  - pluginRef: drain-filter
+  - pluginRef: circuit-breaker-filter
+  - pluginRef: queue-scorer
+  - pluginRef: max-score-picker
+- name: decode
+  plugins:
+  - pluginRef: drain-filter
+  - pluginRef: circuit-breaker-filter
+  - pluginRef: queue-scorer
+    weight: 2
+  - pluginRef: kv-cache-utilization-scorer
+    weight: 2
+  - pluginRef: prefix-cache-scorer
+    weight: 3
+  - pluginRef: max-score-picker
+"""
+
+    # ---------- autoscaler closed loop ----------
+
+    def _build_wva(self) -> VariantAutoscaler:
+        pol = self.scenario.autoscale
+        spec = VariantAutoscalingSpec(
+            model_id=self.scenario.model,
+            slo_ttft_ms=pol.slo_ttft_ms, slo_tpot_ms=pol.slo_tpot_ms,
+            min_replicas=pol.min_replicas, max_replicas=pol.max_replicas,
+            target_saturation=pol.target_saturation, mode=pol.mode)
+        wva = VariantAutoscaler(spec, endpoints=[],
+                                reconcile_interval_s=pol.interval_s)
+        wva.collector = SimCollector(self)
+        wva.desired_replicas = len(self.replicas)
+        return wva
+
+    async def _autoscale_tick(self) -> None:
+        wva = self.wva
+        assert wva is not None
+        desired = await wva.reconcile_once()
+        current = len(self.replicas)
+        pol = self.scenario.autoscale
+        if desired > current:
+            # Scale-up pods share the fleet's pod spec (engine shape,
+            # seat count) — only the zone and boot delay come from the
+            # policy.  A default-shaped pod would lie to the capacity
+            # analyzer's queue-pressure signal.
+            template = (self.scenario.replicas[0] if self.scenario.replicas
+                        else ReplicaGroup(zone=pol.zone, count=0))
+            group = dataclasses.replace(
+                template, zone=pol.zone, count=0,
+                startup_delay_s=pol.startup_delay_s)
+            for _ in range(desired - current):
+                r = self._add_replica(group, scalable=True)
+                self.track(self.spawn_boot(r))
+            self._reconcile_datastore()
+            tracing.trace_event("cluster", "scale.up", to=desired)
+        elif desired < current:
+            victims = [r for a, r in sorted(self.replicas.items(),
+                                            reverse=True)
+                       if r.scalable and r.servable]
+            for r in victims[:current - desired]:
+                r.sim.set_draining()
+                self.track(asyncio.get_running_loop().create_task(
+                    self._drain_and_remove(r)))
+            tracing.trace_event("cluster", "scale.down", to=desired)
+
+    async def _drain_and_remove(self, r: ClusterReplica) -> None:
+        sim = r.sim
+        while sim._running + sim._waiting > 0:
+            await asyncio.sleep(0.5)
+        self._remove_replica(r.address)
+        tracing.trace_event("cluster", "replica.removed",
+                            address=r.address)
+
+    # ---------- the run ----------
+
+    async def _scrape_loop(self, until_s: float) -> None:
+        loop = asyncio.get_running_loop()
+        while loop.time() <= until_s:
+            self._reconcile_datastore()
+            await self.datastore.scrape_once()
+            self.scoreboard.metrics.replicas.set(sum(
+                1 for r in self.replicas.values() if r.servable))
+            await asyncio.sleep(self.datastore.scrape_interval_s)
+
+    async def _autoscale_loop(self, until_s: float) -> None:
+        loop = asyncio.get_running_loop()
+        interval = self.scenario.autoscale.interval_s
+        while loop.time() <= until_s:
+            await asyncio.sleep(interval)
+            await self._autoscale_tick()
+
+    async def _main(self) -> Dict[str, Any]:
+        scenario = self.scenario
+        for group in scenario.replicas:
+            for _ in range(group.count):
+                r = self._add_replica(group)
+                self.track(self.spawn_boot(r))
+        self._reconcile_datastore()
+        await self.datastore.scrape_once()
+        until = scenario.duration_s * 4 + 300.0   # loop horizon > tail
+        loops = [
+            asyncio.get_running_loop().create_task(
+                self._scrape_loop(until)),
+            asyncio.get_running_loop().create_task(
+                self.fault_plane.run(until)),
+        ]
+        if scenario.autoscale.enabled:
+            self.wva = self._build_wva()
+            loops.append(asyncio.get_running_loop().create_task(
+                self._autoscale_loop(until)))
+        workload = Workload(scenario, self.gateway, self.scoreboard)
+        try:
+            await workload.run()
+        finally:
+            for t in loops + self._tasks:
+                t.cancel()
+            await asyncio.gather(*loops, *self._tasks,
+                                 return_exceptions=True)
+        return self._report()
+
+    def _report(self) -> Dict[str, Any]:
+        report = self.scoreboard.report()
+        live = sum(1 for r in self.replicas.values() if r.servable)
+        self.scoreboard.metrics.replicas.set(live)
+        report["scenario"] = {"name": self.scenario.name,
+                              "seed": self.scenario.seed,
+                              "duration_s": self.scenario.duration_s}
+        report["fleet"] = {
+            "replicas_final": len(self.replicas),
+            "replicas_live": live,
+            "replicas_peak": self.replicas_peak,
+            "dead_ever": sorted(self.dead_log),
+            "breakers": dict(sorted(
+                self.datastore.breaker.states().items())),
+            "faults_applied": [
+                [round(t, 3), kind, target]
+                for t, kind, target in self.fault_plane.applied],
+        }
+        return report
+
+    def run(self) -> Dict[str, Any]:
+        """Run the scenario to completion; deterministic per seed."""
+        loop = VirtualClockEventLoop()
+        injector = FaultInjector.from_spec(
+            self.scenario.llmd_faults, seed=self.scenario.seed)
+        asyncio.set_event_loop(loop)
+        random.seed(self.scenario.seed)     # picker tie-breaks
+        install(injector)
+        try:
+            with _VirtualTimePatch(loop):
+                return loop.run_until_complete(self._main())
+        finally:
+            faultinject_reset()
+            asyncio.set_event_loop(None)
+            loop.close()
+
+    def run_json(self) -> str:
+        """The byte-identical-per-seed report serialization."""
+        return json.dumps(self.run(), sort_keys=True, indent=1)
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path, "r", encoding="utf-8") as fh:
+        return Scenario.from_dict(json.load(fh))
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser("llmd-cluster-sim")
+    p.add_argument("--scenario", required=True,
+                   help="scenario JSON file (docs/cluster-sim.md)")
+    p.add_argument("--report", default="",
+                   help="write the scoreboard JSON here (default stdout)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="override the scenario's seed")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    scenario = load_scenario(args.scenario)
+    if args.seed is not None:
+        scenario.seed = args.seed
+    text = ClusterSim(scenario).run_json()
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            fh.write(text)
+    else:
+        print(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,17 @@ Fault-point catalog (see docs/resilience.md):
                         admission (engine/offload.py) — a fired fault is
                         a tier miss: the request recomputes instead
   ``engine.step``       engine step — simulated engine death (engine.py)
+  ``cluster.partition`` cluster-sim virtual network link (sim/cluster.py)
+                        — keyed ``src->dst``, so ``match=`` expresses a
+                        directed P↔D or zone partition
+  ``cluster.zone_kill`` cluster-sim correlated zone/gang kill tick
+                        (sim/cluster.py) — keyed by zone name; a fired
+                        fault takes every replica in the zone down at
+                        once
+  ``cluster.straggler`` cluster-sim per-replica slowdown tick
+                        (sim/cluster.py) — keyed by replica address; a
+                        fired fault multiplies that replica's step time
+                        (``LLMD_SIM_STRAGGLER_FACTOR``)
 
 Rules come from code (tests: ``install(FaultInjector(...))``) or from the
 environment (operators: ``LLMD_FAULTS`` + ``LLMD_FAULT_SEED``)::
@@ -71,6 +82,9 @@ FAULT_POINTS = (
     "kv.peer_fetch",
     "kv.restore",
     "engine.step",
+    "cluster.partition",
+    "cluster.zone_kill",
+    "cluster.straggler",
 )
 
 

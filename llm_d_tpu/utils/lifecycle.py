@@ -21,6 +21,14 @@ drift apart:
                          the 504).
   x-llmd-criticality     SLO class: critical | standard | sheddable
                          (body field ``criticality`` is the alias).
+  x-llmd-tenant          tenant id the request bills/scores under (body
+                         field ``tenant`` is the alias; default tenant
+                         ``"-"``).  Consumed by per-tenant SLO scoring
+                         (cluster sim scoreboard, llmd_tpu:slo_
+                         attainment_ratio) and by per-tenant prefix
+                         pools in the load generator; NOT a routing
+                         input — placement stays tenant-blind so one
+                         tenant cannot skew another's cache locality.
   x-llmd-draining        response marker: the replica refused new work
                          because it is draining.
   x-llmd-sched-depth     response header: the replica's self-reported
@@ -77,6 +85,7 @@ import time
 from typing import Any, Dict, Optional
 
 CRITICALITY_HEADER = "x-llmd-criticality"
+TENANT_HEADER = "x-llmd-tenant"
 DEADLINE_MS_HEADER = "x-llmd-deadline-ms"
 DEADLINE_ABS_HEADER = "x-llmd-deadline"
 DEADLINE_EXCEEDED_HEADER = "x-llmd-deadline-exceeded"
@@ -127,6 +136,26 @@ def parse_criticality(headers: Dict[str, str],
             f"unknown criticality {raw!r} (expected one of "
             f"{'/'.join(CRITICALITIES)})")
     return value
+
+
+DEFAULT_TENANT = "-"
+
+
+def parse_tenant(headers: Dict[str, str],
+                 body: Optional[Dict[str, Any]] = None) -> str:
+    """Tenant id from lowercased headers / body; default ``"-"``.
+
+    Unlike criticality there is no closed vocabulary to validate against
+    — any non-empty string is a tenant.  Whitespace-only ids collapse to
+    the default so scoreboards never grow an invisible tenant row.
+    """
+    raw = headers.get(TENANT_HEADER)
+    if raw is None and body is not None:
+        raw = body.get("tenant")
+    if raw is None:
+        return DEFAULT_TENANT
+    value = str(raw).strip()
+    return value if value else DEFAULT_TENANT
 
 
 def parse_deadline(headers: Dict[str, str],

@@ -88,6 +88,14 @@ EPLB_IMBALANCE_METRIC = "llmd_tpu:eplb_imbalance"
 EPLB_MIGRATIONS_METRIC = "llmd_tpu:eplb_migrations_total"
 EPLB_MIGRATED_BYTES_METRIC = "llmd_tpu:eplb_migrated_bytes_total"
 EPLB_MIGRATION_STALL_METRIC = "llmd_tpu:eplb_migration_stall_seconds"
+# Cluster-sim SLO scoreboard (round 18, chaos testbed): the fraction of
+# a tenant bucket's finished requests that met BOTH their class SLO
+# targets (TTFT and TPOT) over the scenario, and the live replica count
+# the simulated fleet is serving with.  tenant_bucket is a stable hash
+# of the tenant id into LLMD_SIM_TENANT_BUCKETS buckets — thousands of
+# tenants must not become thousands of label values.
+SLO_ATTAINMENT_METRIC = "llmd_tpu:slo_attainment_ratio"
+CLUSTER_SIM_REPLICAS_METRIC = "llmd_tpu:cluster_sim_replicas"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -390,6 +398,30 @@ class EppMetrics:
         self._request_phase.labels(
             phase=phase, criticality=criticality).observe(
             max(0.0, seconds))
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class ClusterMetrics:
+    """Cluster-simulator fleet metrics (the chaos testbed's judge feed).
+
+    One instance per :class:`~llm_d_tpu.sim.cluster.ClusterSim` run; the
+    scoreboard publishes its per-(class, tenant-bucket) attainment here
+    so the same PromQL that would watch production watches a scenario.
+    """
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        self.slo_attainment = Gauge(
+            SLO_ATTAINMENT_METRIC,
+            "Fraction of finished requests meeting BOTH class SLO "
+            "targets (TTFT and TPOT), by class and tenant bucket.",
+            ["criticality", "tenant_bucket"], registry=self.registry)
+        self.replicas = Gauge(
+            CLUSTER_SIM_REPLICAS_METRIC,
+            "Live (booted, not dead, not removed) replicas in the "
+            "simulated fleet.", registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -72,6 +72,13 @@ python -m pytest tests/test_mixed_fusion.py -q
 # step/dispatch amortization counters, LLMD_SPEC_STRICT refusing a
 # degraded boot, and chaos resume from a kill MID N-round dispatch).
 python -m pytest tests/test_everything_on.py -q
+# Cluster chaos-testbed fail-fast (round 18: discrete-event cluster sim
+# with the REAL EPP/datastore/breaker/flow-control/WVA stack in the
+# loop — zone kills and P<->D partitions with zero client-visible
+# critical breaks, breaker convergence on dead endpoints, closed-loop
+# autoscaling beating the identical-seed baseline, and the
+# byte-identical-scoreboard determinism contract).
+python -m pytest tests/test_cluster_sim.py -q
 # Live-EPLB contract fail-fast (round 17: delta-plan migration — budget
 # and hysteresis invariants, atomic double-buffered flip with exact
 # post-flip weights, byte-identical greedy AND seeded parity across a
@@ -89,4 +96,5 @@ python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_everything_on.py \
     --ignore=tests/test_eplb.py \
     --ignore=tests/test_eplb_integration.py \
+    --ignore=tests/test_cluster_sim.py \
     --ignore=tests/test_tracing.py

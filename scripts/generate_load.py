@@ -25,6 +25,24 @@ Examples:
       # multi-token chunks (spec-decode servers emit one frame per
       # engine step) and the summary reports accepted_tokens_per_step
   python scripts/generate_load.py --url http://gw:8000 --qps 10 \
+      --tenants acme:3,bulk:1 --shape prefix
+      # multi-tenant traffic: each request is billed to a weighted-drawn
+      # tenant (x-llmd-tenant) and, under --shape prefix, draws from that
+      # TENANT'S prefix pool — cross-tenant prompts never share prefixes,
+      # so prefix-cache hit rates and the per-tenant SLO scoreboards
+      # (sim/cluster.py) see realistic isolation
+  python scripts/generate_load.py --url http://gw:8000 --qps 10 \
+      --tenants acme:3,bulk:1 --trace-out /tmp/workload.jsonl
+      # record the issued workload as a replayable trace (JSONL of
+      # {at_s, tenant, prompt, max_tokens, criticality, deadline_ms}) —
+      # the SAME records a cluster-sim scenario's "trace" field replays
+      # (docs/cluster-sim.md), so a live-gateway campaign can be re-run
+      # deterministically inside the simulator
+  python scripts/generate_load.py --url http://gw:8000 \
+      --trace-replay /tmp/workload.jsonl --trace-speed 2.0
+      # trace-driven mode: replay a recorded workload against a live
+      # gateway at 2x speed (arrival times honored, not --qps)
+  python scripts/generate_load.py --url http://gw:8000 --qps 10 \
       --trace-export /tmp/run.jsonl
       # post-run: scrape /debug/traces from the gateway (and any
       # --trace-urls), write the span JSONL, and append the llmd-trace
@@ -64,6 +82,7 @@ from llm_d_tpu.utils.lifecycle import (  # noqa: E402
     CRITICALITY_HEADER,
     DEADLINE_EXCEEDED_HEADER,
     DEADLINE_MS_HEADER,
+    TENANT_HEADER,
 )
 
 WORDS = ("tpu mesh shard flash ring latent expert router block cache "
@@ -80,7 +99,7 @@ def pick_criticality(mix: list, rng: random.Random) -> str:
     return mix[-1][0]
 
 
-def make_body(args, rng: random.Random) -> tuple:
+def make_body(args, rng: random.Random, tenant: str = "") -> tuple:
     headers = {}
     criticality = "standard"
     if args.criticality_list:
@@ -88,9 +107,16 @@ def make_body(args, rng: random.Random) -> tuple:
         headers[CRITICALITY_HEADER] = criticality
     if args.deadline_ms > 0:
         headers[DEADLINE_MS_HEADER] = str(args.deadline_ms)
+    if tenant:
+        headers[TENANT_HEADER] = tenant
     if args.shape == "prefix":
+        # Prefix pools are PER TENANT: "acme pool-2 ..." never collides
+        # with "bulk pool-2 ...", so multi-tenant runs exercise the real
+        # cache-isolation shape instead of one global warm pool.
         group = rng.randrange(args.prefix_groups)
-        prompt = (f"shared-prefix-{group} " * args.prefix_len
+        pool = f"{tenant} pool-{group} " if tenant \
+            else f"shared-prefix-{group} "
+        prompt = (pool * args.prefix_len
                   + " ".join(rng.choices(WORDS, k=4)))
     else:
         prompt = " ".join(rng.choices(WORDS, k=args.prompt_words))
@@ -127,6 +153,57 @@ def parse_criticality_mix(spec: str) -> list:
     return out
 
 
+def parse_tenant_mix(spec: str) -> list:
+    """"tenant:weight[,tenant:weight...]" -> [(tenant, weight)]; bad
+    entries dropped."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        tenant, _, weight = entry.partition(":")
+        tenant = tenant.strip()
+        if not tenant:
+            continue
+        try:
+            out.append((tenant, float(weight or 1.0)))
+        except ValueError:
+            print(f"--tenants: dropping malformed entry {entry!r}")
+    return out
+
+
+def pick_tenant(mix: list, rng: random.Random) -> str:
+    if not mix:
+        return ""
+    r = rng.random() * sum(w for _, w in mix)
+    for tenant, w in mix:
+        r -= w
+        if r < 0:
+            return tenant
+    return mix[-1][0]
+
+
+def load_trace(path: str) -> list:
+    """Read a replayable workload trace (JSONL of {at_s, tenant, prompt,
+    max_tokens, criticality, deadline_ms} — the format --trace-out emits
+    and a cluster-sim scenario's "trace" field consumes).  Malformed
+    lines are dropped with a note."""
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                rec["at_s"] = float(rec.get("at_s", 0.0))
+                records.append(rec)
+            except (ValueError, TypeError, AttributeError):
+                print(f"--trace-replay: dropping malformed line {i + 1}")
+    records.sort(key=lambda r: r["at_s"])
+    return records
+
+
 def parse_faults(spec: str) -> dict:
     """"kind:rate[,kind:rate...]" -> {kind: rate}; bad entries dropped."""
     out = {}
@@ -149,12 +226,40 @@ def pick_fault(faults: dict, rng: random.Random):
     return None
 
 
-async def one_request(session, args, rng, stats) -> None:
-    body, headers, criticality = make_body(args, rng)
+async def one_request(session, args, rng, stats, tenant: str = "",
+                      override: dict | None = None) -> None:
+    if override is not None:
+        # Trace-replay record: the request IS the record, verbatim.
+        tenant = str(override.get("tenant", "") or "")
+        criticality = str(override.get("criticality", "standard"))
+        headers = {}
+        if tenant:
+            headers[TENANT_HEADER] = tenant
+        if criticality != "standard":
+            headers[CRITICALITY_HEADER] = criticality
+        if override.get("deadline_ms"):
+            headers[DEADLINE_MS_HEADER] = str(override["deadline_ms"])
+        body = {"model": args.model,
+                "prompt": str(override.get("prompt", "replay")),
+                "max_tokens": int(override.get("max_tokens",
+                                               args.max_tokens)),
+                "temperature": args.temperature}
+    else:
+        body, headers, criticality = make_body(args, rng, tenant)
+    if args.trace_out is not None:
+        stats.setdefault("_trace", []).append({
+            "at_s": round(time.monotonic() - stats["_t0"], 4),
+            "tenant": tenant, "prompt": body.get("prompt"),
+            "max_tokens": body.get("max_tokens"),
+            "criticality": criticality,
+            "deadline_ms": args.deadline_ms or None})
     fault = pick_fault(args.fault_map, rng)
     cls = stats.setdefault("per_class", {}).setdefault(
         criticality, {"latencies": [], "deadline_miss": 0, "requests": 0})
     cls["requests"] += 1
+    if tenant:
+        stats.setdefault("per_tenant", {}).setdefault(
+            tenant, {"requests": 0})["requests"] += 1
     t0 = time.perf_counter()
     try:
         if fault == "malformed":
@@ -232,17 +337,31 @@ async def one_request(session, args, rng, stats) -> None:
 
 async def run(args) -> None:
     rng = random.Random(args.seed)
-    stats: dict = {}
+    stats: dict = {"_t0": time.monotonic()}
     deadline = time.monotonic() + args.duration
     interval = 1.0 / args.qps
     async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=120)) as session:
         pending = set()
-        while time.monotonic() < deadline:
-            pending.add(asyncio.create_task(
-                one_request(session, args, rng, stats)))
-            pending = {t for t in pending if not t.done()}
-            await asyncio.sleep(interval)
+        if args.trace_replay:
+            # Trace-driven: arrival times come from the recorded trace
+            # (scaled by --trace-speed), not --qps/--duration.
+            t0 = time.monotonic()
+            for rec in load_trace(args.trace_replay):
+                due = t0 + rec["at_s"] / max(args.trace_speed, 1e-9)
+                delay = due - time.monotonic()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                pending.add(asyncio.create_task(
+                    one_request(session, args, rng, stats, override=rec)))
+                pending = {t for t in pending if not t.done()}
+        else:
+            while time.monotonic() < deadline:
+                pending.add(asyncio.create_task(
+                    one_request(session, args, rng, stats,
+                                tenant=pick_tenant(args.tenant_list, rng))))
+                pending = {t for t in pending if not t.done()}
+                await asyncio.sleep(interval)
         if pending:
             await asyncio.gather(*pending, return_exceptions=True)
     def pct(sorted_lats, q):
@@ -250,6 +369,13 @@ async def run(args) -> None:
                                 len(sorted_lats) - 1)]
                 if sorted_lats else 0.0)
 
+    stats.pop("_t0", None)
+    trace_records = stats.pop("_trace", [])
+    if args.trace_out is not None:
+        with open(args.trace_out, "w") as f:
+            for rec in trace_records:
+                f.write(json.dumps(rec) + "\n")
+    per_tenant = stats.pop("per_tenant", {})
     lats = sorted(stats.pop("latencies", []))
     per_class = {}
     for cls, c in stats.pop("per_class", {}).items():
@@ -274,6 +400,11 @@ async def run(args) -> None:
         "latency_p99_s": round(pct(lats, 0.99), 4),
         "per_class": per_class,
     }
+    if per_tenant:
+        summary["per_tenant"] = per_tenant
+    if args.trace_out is not None:
+        summary["trace_out"] = {"path": args.trace_out,
+                                "records": len(trace_records)}
     if args.stream:
         summary["stream_breaks"] = breaks
         summary["continuity_errors"] = cont_errors
@@ -365,10 +496,29 @@ def main() -> None:
                     help="comma list of base URLs to scrape traces from "
                          "(default: --url; add model-server/sidecar "
                          "URLs when they run in separate processes)")
+    ap.add_argument("--tenants", default="",
+                    help="multi-tenant traffic mix, tenant:weight[,...]; "
+                         "each request is billed to a weighted-drawn "
+                         "tenant (x-llmd-tenant) and --shape prefix "
+                         "draws from that tenant's own prefix pool")
+    ap.add_argument("--trace-out", default=None,
+                    help="record the issued workload as a replayable "
+                         "JSONL trace ({at_s, tenant, prompt, "
+                         "max_tokens, criticality, deadline_ms}) — the "
+                         "format --trace-replay and a cluster-sim "
+                         "scenario's \"trace\" field consume")
+    ap.add_argument("--trace-replay", default=None,
+                    help="trace-driven mode: replay a recorded workload "
+                         "trace (arrival times honored; --qps/--duration "
+                         "ignored)")
+    ap.add_argument("--trace-speed", type=float, default=1.0,
+                    help="replay speed multiplier for --trace-replay "
+                         "(2.0 = twice as fast)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
     args.fault_map = parse_faults(args.faults)
     args.criticality_list = parse_criticality_mix(args.criticality_mix)
+    args.tenant_list = parse_tenant_mix(args.tenants)
     asyncio.run(run(args))
 
 

@@ -1,0 +1,427 @@
+"""Cluster-scale chaos testbed (sim/cluster.py).
+
+Fast tier: ≤12-replica scenarios proving each mechanism — virtual-clock
+speed, zone-kill mid-stream failover with zero client-visible breaks,
+P↔D partition → prefill local-fallback, stragglers in the tail,
+flow-control shedding, deadline misses, seeded `LLMD_FAULTS` cluster
+points (`cluster.partition`, `cluster.zone_kill`, `cluster.straggler`),
+the closed-loop WVA autoscaler, and the byte-identical-scoreboard
+contract.
+
+Slow tier: the ≥100-replica acceptance scenario — zone kill + P↔D
+partition + stragglers under multi-tenant diurnal load, judged entirely
+by the scoreboard.
+"""
+
+import json
+import time
+
+import pytest
+
+from llm_d_tpu.sim.cluster import (
+    ClusterSim,
+    FaultEvent,
+    Scenario,
+    tenant_bucket,
+)
+from llm_d_tpu.utils.faultinject import FAULT_POINTS
+from llm_d_tpu.utils.lifecycle import DEFAULT_TENANT, parse_tenant
+
+
+def _run(d):
+    sim = ClusterSim(Scenario.from_dict(d))
+    return sim, sim.run()
+
+
+# ---------------------------------------------------------------------------
+# Registration / helpers
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_fault_points_registered():
+    for point in ("cluster.partition", "cluster.zone_kill",
+                  "cluster.straggler"):
+        assert point in FAULT_POINTS
+
+
+def test_tenant_header_parsing():
+    assert parse_tenant({"x-llmd-tenant": "acme"}) == "acme"
+    assert parse_tenant({}, {"tenant": "bulk"}) == "bulk"
+    assert parse_tenant({"x-llmd-tenant": "  "}) == DEFAULT_TENANT
+    assert parse_tenant({}) == DEFAULT_TENANT
+
+
+def test_tenant_bucket_is_stable_and_bounded():
+    # sha256-based: stable across processes (unlike hash()), bounded by
+    # the bucket count.
+    assert tenant_bucket("acme", 8) == tenant_bucket("acme", 8)
+    assert 0 <= int(tenant_bucket("acme", 8)) < 8
+    buckets = {tenant_bucket(f"t{i}", 4) for i in range(64)}
+    assert buckets == {"0", "1", "2", "3"}
+
+
+# ---------------------------------------------------------------------------
+# Core mechanisms (fast tier)
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_clock_outruns_wall_clock():
+    t0 = time.perf_counter()
+    _sim, rep = _run({
+        "name": "clock", "seed": 1, "duration_s": 300.0,
+        "replicas": [{"zone": "zone-a", "count": 2}],
+        "tenants": [{"name": "t", "qps": 0.2, "max_tokens": 4}],
+    })
+    wall = time.perf_counter() - t0
+    assert wall < 300.0 / 10          # 300 virtual seconds, CPU seconds
+    assert rep["classes"]["standard"]["requests"] > 20
+    # The time patch must be fully unwound after run().
+    assert abs(time.time() - time.monotonic()) > 1e6
+
+
+def test_zone_kill_streams_resume_with_zero_client_breaks():
+    sim, rep = _run({
+        "name": "zone-kill", "seed": 3, "duration_s": 12.0,
+        "replicas": [{"zone": "zone-a", "count": 2},
+                     {"zone": "zone-b", "count": 2}],
+        "tenants": [{"name": "acme", "qps": 10.0,
+                     "criticality": "critical", "max_tokens": 300}],
+        "faults": [{"at_s": 4.0, "kind": "zone_kill", "target": "zone-b"}],
+        "breaker_failures": 1,
+    })
+    cell = rep["tenants"]["acme"]["critical"]
+    assert cell["stream_breaks"] == 0
+    assert cell["ok"] == cell["requests"] > 50
+    assert sum(cell["resumes"].values()) > 0    # kills landed mid-stream
+    # Breaker convergence: every dead endpoint is tripped (non-closed)
+    # or scrape-excluded from routing.
+    assert set(rep["fleet"]["dead_ever"]) == {"zone-b-0:8200",
+                                             "zone-b-1:8200"}
+    for addr in rep["fleet"]["dead_ever"]:
+        converged = (rep["fleet"]["breakers"][addr] != "closed"
+                     or not sim.datastore.endpoints[addr].ready)
+        assert converged, addr
+
+
+def test_pd_partition_falls_back_to_local_prefill():
+    _sim, rep = _run({
+        "name": "pd-cut", "seed": 11, "duration_s": 20.0,
+        "pd_threshold": 64,
+        "replicas": [{"zone": "zone-a", "count": 3, "role": "decode"},
+                     {"zone": "zone-p", "count": 2, "role": "prefill"}],
+        "tenants": [{"name": "ragco", "qps": 2.0, "kind": "rag",
+                     "criticality": "standard", "max_tokens": 24}],
+        "faults": [
+            {"at_s": 5.0, "kind": "partition",
+             "target": "role:decode|role:prefill"},
+            {"at_s": 14.0, "kind": "partition_heal",
+             "target": "role:decode|role:prefill"},
+        ],
+        "breaker_failures": 1,
+    })
+    cell = rep["tenants"]["ragco"]["standard"]
+    assert cell["prefill_fallback"] > 0     # cut window recomputed locally
+    assert cell["stream_breaks"] == 0       # fallback is never a break
+    assert cell["ok"] == cell["requests"]
+
+
+def test_straggler_stretches_the_tail_not_the_median():
+    d = {
+        "name": "straggle", "seed": 5, "duration_s": 20.0,
+        "replicas": [{"zone": "zone-a", "count": 4}],
+        "tenants": [{"name": "t", "qps": 4.0, "max_tokens": 16}],
+        "faults": [{"at_s": 2.0, "kind": "straggler",
+                    "target": "zone-a-0:8200", "factor": 6.0}],
+    }
+    _sim, rep = _run(d)
+    cell = rep["classes"]["standard"]
+    assert cell["tpot_p50_ms"] == pytest.approx(10.0, abs=2.0)
+    assert cell["tpot_p99_ms"] >= 4 * cell["tpot_p50_ms"]
+
+
+def test_seeded_llmd_faults_drive_cluster_points():
+    # The LLMD_FAULTS grammar reaches the cluster points: a seeded
+    # one-shot cluster.zone_kill rule gang-kills the matched zone.
+    _sim, rep = _run({
+        "name": "grammar", "seed": 9, "duration_s": 15.0,
+        "replicas": [{"zone": "zone-a", "count": 2},
+                     {"zone": "zone-b", "count": 2}],
+        "tenants": [{"name": "t", "qps": 3.0, "max_tokens": 8}],
+        "llmd_faults": "cluster.zone_kill:count=1,after=4,match=zone-b",
+        "breaker_failures": 1,
+    })
+    assert set(rep["fleet"]["dead_ever"]) == {"zone-b-0:8200",
+                                             "zone-b-1:8200"}
+    kinds = [k for _, k, tgt in rep["fleet"]["faults_applied"]
+             if tgt == "zone-b"]
+    assert "zone_kill" in kinds
+
+
+def test_injected_partition_point_breaks_links():
+    # cluster.partition keyed "src->dst": a probabilistic link fault on
+    # every hop still ends with every request served (retry/resume).
+    _sim, rep = _run({
+        "name": "flaky-links", "seed": 13, "duration_s": 15.0,
+        "replicas": [{"zone": "zone-a", "count": 3}],
+        "tenants": [{"name": "t", "qps": 3.0,
+                     "criticality": "critical", "max_tokens": 12}],
+        "llmd_faults": "cluster.partition:p=0.05",
+        "breaker_failures": 3,
+    })
+    cell = rep["tenants"]["t"]["critical"]
+    assert cell["requests"] > 20
+    assert cell["stream_breaks"] == 0
+    assert cell["ok"] == cell["requests"]
+
+
+def test_flow_control_sheds_sheddable_keeps_critical():
+    _sim, rep = _run({
+        "name": "overload", "seed": 21, "duration_s": 10.0,
+        "replicas": [{"zone": "zone-a", "count": 1, "max_num_seqs": 2}],
+        "tenants": [
+            {"name": "vip", "qps": 2.0, "criticality": "critical",
+             "max_tokens": 40},
+            {"name": "bulk", "qps": 30.0, "criticality": "sheddable",
+             "max_tokens": 40},
+        ],
+        "max_inflight": 4, "max_queue": 4,
+    })
+    assert rep["tenants"]["bulk"]["sheddable"]["shed"] > 0
+    vip = rep["tenants"]["vip"]["critical"]
+    assert vip["shed"] == 0
+    assert vip["ok"] == vip["requests"]
+
+
+def test_deadlines_expire_and_are_counted():
+    _sim, rep = _run({
+        "name": "deadlines", "seed": 17, "duration_s": 10.0,
+        "replicas": [{"zone": "zone-a", "count": 1, "max_num_seqs": 2,
+                      "tpot_ms": 20.0}],
+        "tenants": [{"name": "t", "qps": 8.0, "max_tokens": 50,
+                     "deadline_ms": 300}],
+    })
+    cell = rep["tenants"]["t"]["standard"]
+    assert cell["deadline_miss"] > 0
+    assert cell["deadline_miss"] + cell["ok"] + cell["rejected"] \
+        == cell["requests"]
+    assert cell["attainment"] < 1.0
+
+
+def test_drain_event_routes_away_without_breaks():
+    _sim, rep = _run({
+        "name": "drain", "seed": 23, "duration_s": 15.0,
+        "replicas": [{"zone": "zone-a", "count": 3}],
+        "tenants": [{"name": "t", "qps": 5.0, "max_tokens": 30}],
+        "faults": [{"at_s": 5.0, "kind": "drain",
+                    "target": "zone-a-1:8200"}],
+    })
+    cell = rep["classes"]["standard"]
+    assert cell["stream_breaks"] == 0
+    assert cell["ok"] == cell["requests"]
+
+
+def test_multi_tenant_prefix_pools_and_agent_sessions():
+    _sim, rep = _run({
+        "name": "tenants", "seed": 29, "duration_s": 20.0,
+        "replicas": [{"zone": "zone-a", "count": 2}],
+        "tenants": [
+            {"name": "acme", "qps": 2.0, "kind": "chat",
+             "prefix_groups": 2, "max_tokens": 8},
+            {"name": "agents", "qps": 0.5, "kind": "agent", "turns": 3,
+             "criticality": {"standard": 0.5, "sheddable": 0.5},
+             "max_tokens": 8},
+        ],
+    })
+    assert "acme" in rep["tenants"] and "agents" in rep["tenants"]
+    agent_reqs = sum(c["requests"] for c in rep["tenants"]["agents"]
+                     .values())
+    assert agent_reqs >= 3              # at least one full session
+    # Per-class attainment buckets exist for every class seen.
+    for crit in rep["classes"]:
+        assert crit in rep["attainment"]
+
+
+def test_trace_replay_issues_records_verbatim():
+    trace = [{"at_s": 1.0 + 0.25 * i, "tenant": "replayed",
+              "prompt": f"trace prompt {i}", "max_tokens": 6,
+              "criticality": "critical"} for i in range(12)]
+    _sim, rep = _run({
+        "name": "replay", "seed": 31, "duration_s": 8.0,
+        "replicas": [{"zone": "zone-a", "count": 2}],
+        "tenants": [], "trace": trace,
+    })
+    cell = rep["tenants"]["replayed"]["critical"]
+    assert cell["requests"] == 12
+    assert cell["ok"] == 12
+
+
+def test_scoreboard_is_byte_identical_across_runs():
+    d = {
+        "name": "determinism", "seed": 37, "duration_s": 15.0,
+        "pd_threshold": 64,
+        "replicas": [{"zone": "zone-a", "count": 3, "role": "decode"},
+                     {"zone": "zone-b", "count": 3, "role": "decode"},
+                     {"zone": "zone-p", "count": 2, "role": "prefill"}],
+        "tenants": [
+            {"name": "acme", "qps": 4.0, "criticality": "critical",
+             "max_tokens": 40},
+            {"name": "ragco", "qps": 1.0, "kind": "rag",
+             "max_tokens": 16},
+        ],
+        "diurnal": {"period_s": 15.0, "low": 0.3, "high": 1.0},
+        "faults": [{"at_s": 5.0, "kind": "zone_kill", "target": "zone-b"},
+                   {"at_s": 10.0, "kind": "zone_restore",
+                    "target": "zone-b", "restart_delay_s": 2.0}],
+        "llmd_faults": "cluster.straggler:p=0.02",
+        "breaker_failures": 1,
+    }
+    j1 = ClusterSim(Scenario.from_dict(d)).run_json()
+    j2 = ClusterSim(Scenario.from_dict(d)).run_json()
+    assert j1 == j2
+    other = ClusterSim(Scenario.from_dict(dict(d, seed=38))).run_json()
+    assert other != j1                  # the seed actually matters
+
+
+def test_fault_event_from_dict_keeps_params():
+    ev = FaultEvent.from_dict({"at_s": 3, "kind": "straggler",
+                               "target": "a:1", "factor": 5.0})
+    assert ev.at_s == 3.0 and ev.params == {"factor": 5.0}
+
+
+# ---------------------------------------------------------------------------
+# Closed-loop autoscaling (fast tier)
+# ---------------------------------------------------------------------------
+
+
+def test_wva_closed_loop_scales_up_on_burst_and_down_at_trough():
+    # prefix_groups is high on purpose: with the default 4 pools every
+    # prompt is a full prefix-cache hit on a pinned replica, and the
+    # weight-3 prefix scorer beats the weight-2 queue scorer by exactly
+    # the margin of a full match — fresh replicas then never win a pick
+    # and autoscaling is useless.  Diverse traffic is what autoscaling
+    # can actually absorb; the pinning arithmetic itself is documented
+    # in docs/cluster-sim.md.
+    def scenario(auto):
+        return {
+            "name": "wva-loop", "seed": 41, "duration_s": 60.0,
+            "replicas": [{"zone": "zone-a", "count": 2,
+                          "max_num_seqs": 4}],
+            "tenants": [{"name": "acme", "qps": 40.0,
+                         "prefix_groups": 500,
+                         "criticality": "critical", "max_tokens": 24}],
+            "diurnal": {"period_s": 60.0, "low": 0.05, "high": 1.0},
+            "autoscale": {"enabled": auto, "min_replicas": 2,
+                          "max_replicas": 12, "target_saturation": 0.6,
+                          "interval_s": 5.0, "zone": "zone-a",
+                          "startup_delay_s": 2.0},
+            "scrape_interval_s": 1.0,
+        }
+
+    _, base = _run(scenario(False))
+    sim, rep = _run(scenario(True))
+    # Scale-up happened mid-burst and receded by the trough.
+    assert rep["fleet"]["replicas_peak"] > 2
+    assert rep["fleet"]["replicas_final"] < rep["fleet"]["replicas_peak"]
+    # The whole cycle — including every drain-based scale-down — broke
+    # zero streams and shed nothing critical.
+    cell = rep["tenants"]["acme"]["critical"]
+    base_cell = base["tenants"]["acme"]["critical"]
+    assert cell["stream_breaks"] == 0
+    assert cell["shed"] == 0
+    assert cell["ok"] == cell["requests"]
+    # Scale-up beat the queue: against the identical seed with the
+    # autoscaler off, capacity arriving mid-burst collapses the tail and
+    # lifts attainment from a failing grade to near-perfect.
+    assert cell["ttft_p99_ms"] < base_cell["ttft_p99_ms"] / 2
+    assert cell["attainment"] > base_cell["attainment"] + 0.3
+    assert cell["attainment"] > 0.9
+    assert sim.wva is not None and sim.wva.desired_replicas >= 2
+
+
+# ---------------------------------------------------------------------------
+# Acceptance scenario (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_acceptance_100_replica_incident_scoreboard():
+    """The issue's acceptance gate: a seeded ≥100-replica fleet through
+    zone kill + P↔D partition + stragglers under diurnal multi-tenant
+    load — zero client-visible breaks for the critical class, breaker
+    convergence on every dead endpoint, per-tenant scoreboards, and a
+    byte-identical report across two runs of the same seed."""
+    d = {
+        "name": "acceptance", "seed": 1009, "duration_s": 120.0,
+        "pd_threshold": 64,
+        "replicas": [
+            {"zone": "zone-a", "count": 48, "role": "decode"},
+            {"zone": "zone-b", "count": 48, "role": "decode"},
+            {"zone": "zone-p", "count": 8, "role": "prefill"},
+        ],
+        "tenants": [
+            # Streams long enough (~1 s) that several are always
+            # mid-flight on zone-b when the kill lands — the resume
+            # path must fire, not dodge the incident.  prefix_groups
+            # must span the fleet: with the default 4 pools the
+            # prefix scorer pins ALL of acme to ≤4 replicas, which at
+            # this seed all sit in zone-a and the kill hits nothing
+            # (the docs/cluster-sim.md pinning case study, observed
+            # live).
+            {"name": "acme", "qps": 12.0, "criticality": "critical",
+             "max_tokens": 100, "prefix_groups": 96,
+             "deadline_ms": 30000},
+            {"name": "ragco", "qps": 2.0, "kind": "rag",
+             "criticality": "standard", "max_tokens": 24},
+            {"name": "agents", "qps": 0.5, "kind": "agent", "turns": 3,
+             "criticality": {"standard": 0.6, "sheddable": 0.4},
+             "max_tokens": 16},
+        ],
+        "diurnal": {"period_s": 120.0, "low": 0.3, "high": 1.0},
+        "faults": [
+            {"at_s": 30.0, "kind": "zone_kill", "target": "zone-b"},
+            {"at_s": 50.0, "kind": "partition",
+             "target": "role:decode|role:prefill"},
+            {"at_s": 80.0, "kind": "partition_heal",
+             "target": "role:decode|role:prefill"},
+            {"at_s": 60.0, "kind": "straggler",
+             "target": "zone-a-0:8200", "factor": 5.0},
+            {"at_s": 60.0, "kind": "straggler",
+             "target": "zone-a-1:8200", "factor": 5.0},
+        ],
+        "breaker_failures": 1,
+        "scrape_interval_s": 2.0,
+    }
+    sim = ClusterSim(Scenario.from_dict(d))
+    rep = sim.run()
+    assert rep["fleet"]["replicas_peak"] >= 100
+
+    # Zero client-visible stream breaks for the critical class, across
+    # the zone kill AND the P↔D cut AND the stragglers.
+    crit = rep["classes"]["critical"]
+    assert crit["stream_breaks"] == 0
+    assert crit["requests"] > 300
+    assert crit["no_endpoint"] == 0
+
+    # The incident was actually exercised: the whole of zone-b died and
+    # mid-stream failovers happened.
+    assert len(rep["fleet"]["dead_ever"]) == 48
+    acme = rep["tenants"]["acme"]["critical"]
+    assert sum(acme["resumes"].values()) > 0
+    assert rep["tenants"]["ragco"]["standard"]["prefill_fallback"] > 0
+
+    # Breaker convergence on EVERY dead endpoint: tripped or
+    # scrape-excluded from routing (never silently routable).
+    for addr in rep["fleet"]["dead_ever"]:
+        converged = (rep["fleet"]["breakers"][addr] != "closed"
+                     or not sim.datastore.endpoints[addr].ready)
+        assert converged, addr
+
+    # Per-tenant scoreboards with sane percentile ordering.
+    for tenant in ("acme", "ragco", "agents"):
+        assert tenant in rep["tenants"]
+    assert acme["ttft_p99_ms"] >= acme["ttft_p50_ms"] > 0
+
+    # Same seed, byte-identical scoreboard.
+    rep2 = ClusterSim(Scenario.from_dict(d)).run()
+    assert json.dumps(rep, sort_keys=True) == \
+        json.dumps(rep2, sort_keys=True)
