@@ -23,7 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from llm_d_tpu.epp.datastore import Datastore, EndpointState
 from llm_d_tpu.utils.hashing import hash_block
-from llm_d_tpu.utils.lifecycle import PREFILLER_HEADER
+from llm_d_tpu.utils.lifecycle import KV_PLACEMENT_HEADER, PREFILLER_HEADER
 
 Scores = Dict[str, float]
 
@@ -294,6 +294,97 @@ class PrecisePrefixCacheScorer(Plugin):
             n = self.indexer.longest_prefix(keys, e.address)
             out[e.address] = n / len(keys)
         return out
+
+
+class KvPlacementScorer(Plugin):
+    """Transfer-cost-aware KV placement: score = inverted expected TTFT.
+
+    Per candidate the expected TTFT is the queue/load cost (the analytic
+    latency predictor over the endpoint's live scrape signals) for the
+    tokens it would actually have to prefill, PLUS the modeled wire cost
+    of restoring the prefix blocks it lacks from the best peer replica or
+    shared host tier (``PrefixIndex.restorable_prefix`` + the
+    ``TransferCostModel``'s per-link byte pricing).  Unlike
+    residency-fraction affinity scoring, cached-prefix benefit here
+    SATURATES: a fully-cached replica's advantage is bounded by the
+    prefill cost it avoids, while its queue cost grows without bound — so
+    the docs/cluster-sim.md pinning pathology (a hot replica outscoring
+    idle scale-up capacity forever) disappears by construction, and a
+    warm peer turns a would-be recompute into a cheap restore.
+
+    The picked endpoint's plan lands on ``ctx.kv_restore_plan`` (the sim
+    and a restore-capable gateway consume it) and its verdict — local_hit
+    / peer_restore / recompute — on the ``x-llmd-kv-placement`` response
+    header and the ``llmd_tpu:kv_placement_decision_total`` counter.
+    """
+
+    def __init__(self, name, params, datastore, indexer=None, metrics=None):
+        super().__init__(name, params, datastore)
+        ipc = params.get("indexerConfig", {}).get(
+            "tokenProcessorConfig", {})
+        self.block_size = int(ipc.get("blockSize",
+                                      params.get("blockSize", 64)))
+        # KV bytes per token across all layers (kv_bytes_per_token_layer x
+        # num_layers); the default prices a mid-size bf16 model.  Deploy
+        # profiles should set this from the served model's geometry.
+        self.kv_bytes_per_token = int(params.get("kvBytesPerToken", 131072))
+        self.indexer = indexer
+        self.metrics = metrics
+        self.predictor = AnalyticLatencyPredictor(params)
+        from llm_d_tpu.predictor.model import TransferCostModel
+        self.transfer = TransferCostModel()
+
+    def score(self, ctx, candidates):
+        if not candidates:
+            return None
+        # Token ids only (like the precise scorer): UTF-8 fallback hashes
+        # would never match the engine's token-chained KV events.
+        keys = (ctx.block_keys(self.block_size)
+                if self.indexer is not None and ctx.token_ids else [])
+        n_tokens = float(len(ctx.token_ids) if ctx.token_ids
+                         else len(ctx.prompt_text) // 4)
+        costs: Dict[str, float] = {}
+        plans: Dict[str, Dict[str, Any]] = {}
+        for e in candidates:
+            local = peer = 0
+            source, tier, nbytes = None, "device", 0
+            if keys:
+                rp = self.indexer.restorable_prefix(keys, e.address)
+                local, peer = rp.local_blocks, rp.peer_blocks
+                source, tier = rp.source, rp.tier
+                nbytes = rp.nbytes or (
+                    peer * self.block_size * self.kv_bytes_per_token)
+            miss_tokens = max(
+                0.0, n_tokens - (local + peer) * self.block_size)
+            cost = self.predictor.predict(
+                e, prompt_tokens=miss_tokens)["ttft_ms"]
+            restore_ms = 0.0
+            if peer:
+                restore_ms = self.transfer.restore_ms(
+                    nbytes, "host" if tier == "host" else "peer")
+                cost += restore_ms
+            verdict = ("peer_restore" if peer
+                       else "local_hit" if local else "recompute")
+            plans[e.address] = {
+                "verdict": verdict, "local_blocks": local,
+                "peer_blocks": peer, "source": source, "tier": tier,
+                "restore_bytes": nbytes if peer else 0,
+                "restore_ms": restore_ms, "block_size": self.block_size,
+            }
+            costs[e.address] = cost
+        ctx._kv_plan_map = plans
+        return _minmax(costs, invert=True)
+
+    def on_picked(self, ctx, endpoint, profile):
+        plans = getattr(ctx, "_kv_plan_map", None)
+        if not plans or endpoint.address not in plans:
+            return
+        plan = plans[endpoint.address]
+        ctx.kv_restore_plan = plan
+        ctx.headers[KV_PLACEMENT_HEADER] = plan["verdict"]
+        if self.metrics is not None:
+            self.metrics.kv_placement_decisions.labels(
+                verdict=plan["verdict"]).inc()
 
 
 # ---------- pickers ----------
@@ -572,6 +663,7 @@ PLUGIN_TYPES = {
     "kv-cache-utilization-scorer": KvCacheUtilizationScorer,
     "prefix-cache-scorer": PrefixCacheScorer,
     "precise-prefix-cache-scorer": PrecisePrefixCacheScorer,
+    "kv-placement-scorer": KvPlacementScorer,
     "max-score-picker": MaxScorePicker,
     "random-picker": RandomPicker,
     "single-profile-handler": SingleProfileHandler,

@@ -20,6 +20,7 @@ from llm_d_tpu.epp.config import EndpointPickerConfig
 from llm_d_tpu.epp.datastore import Datastore, EndpointState
 from llm_d_tpu.epp.plugins import (
     PLUGIN_TYPES,
+    KvPlacementScorer,
     PdProfileHandler,
     Plugin,
     PrecisePrefixCacheScorer,
@@ -72,6 +73,9 @@ class EppScheduler:
             if cls is PrecisePrefixCacheScorer:
                 inst = cls(spec.name, spec.parameters, datastore,
                            indexer=indexer)
+            elif cls is KvPlacementScorer:
+                inst = cls(spec.name, spec.parameters, datastore,
+                           indexer=indexer, metrics=self.metrics)
             elif cls is PdProfileHandler:
                 inst = cls(spec.name, spec.parameters, datastore,
                            metrics=self.metrics)

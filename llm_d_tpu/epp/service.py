@@ -46,6 +46,7 @@ from llm_d_tpu.utils.lifecycle import (
     CRITICALITY_SHEDDABLE,
     DEADLINE_ABS_HEADER,
     DEADLINE_EXCEEDED_HEADER,
+    KV_PLACEMENT_HEADER,
     REQUEST_ID_HEADER,
     RETRY_ATTEMPT_HEADER,
     RETRY_BUDGET_HEADER,
@@ -463,6 +464,12 @@ class Gateway:
                     resp.headers[DESTINATION_HEADER] = primary.address
                     resp.headers[RETRY_BUDGET_HEADER] = \
                         f"{attempt}/{max_attempts - 1}"
+                    # Placement verdict back to the client so load
+                    # campaigns report the same local_hit/peer_restore/
+                    # recompute mix as the sim scoreboard.
+                    if KV_PLACEMENT_HEADER in result.headers:
+                        resp.headers[KV_PLACEMENT_HEADER] = \
+                            result.headers[KV_PLACEMENT_HEADER]
                     await resp.prepare(request)
                     if journal is not None and upstream.status == 200:
                         await stream_resume.relay_stream(
@@ -689,7 +696,8 @@ def build_gateway(
                           resolver=resolver,
                           resolve_interval_s=resolve_interval_s,
                           breaker=breaker)
-    needs_index = any(p.type == "precise-prefix-cache-scorer"
+    needs_index = any(p.type in ("precise-prefix-cache-scorer",
+                                 "kv-placement-scorer")
                       for p in config.plugins)
     subscriber = None
     if indexer is None and needs_index:

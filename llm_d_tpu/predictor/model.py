@@ -209,6 +209,101 @@ class StepTimeModel:
         return lo_b
 
 
+class TransferCostModel:
+    """KV restore-link cost model for transfer-aware placement:
+
+        restore_ms(source)  ~=  setup + nbytes / rate(source)
+
+    one (setup, rate) pair per link class — ``peer`` (replica-to-replica
+    over the data-plane interconnect) and ``host`` (shared host-offload
+    tier).  Analytic priors come from the LLMD_KV_TRANSFER_* knobs;
+    observed transfers (the same per-link byte accounting that feeds
+    ``llmd_tpu:collective_bytes_total``: bytes moved, seconds taken)
+    calibrate each link with the StepTimeModel's accumulated
+    normal-equations ridge — O(1) memory, no retrain loop — and a
+    calibrated link overrides its prior.  JSON round-trip matches the
+    latency models so prediction sidecars can sync it.
+    """
+
+    SOURCES = ("peer", "host")
+
+    def __init__(self, peer_gbps: Optional[float] = None,
+                 host_gbps: Optional[float] = None,
+                 setup_ms: Optional[float] = None,
+                 min_samples: int = 8, l2: float = 1e-3) -> None:
+        from llm_d_tpu.utils.config import env_float
+
+        self.peer_gbps = (env_float("LLMD_KV_TRANSFER_PEER_GBPS", 16.0)
+                          if peer_gbps is None else float(peer_gbps))
+        self.host_gbps = (env_float("LLMD_KV_TRANSFER_HOST_GBPS", 64.0)
+                          if host_gbps is None else float(host_gbps))
+        self.setup_ms = (env_float("LLMD_KV_TRANSFER_SETUP_MS", 2.0)
+                         if setup_ms is None else float(setup_ms))
+        self.min_samples = min_samples
+        self.l2 = l2
+        self._xtx = {s: np.zeros((2, 2)) for s in self.SOURCES}
+        self._xty = {s: np.zeros(2) for s in self.SOURCES}
+        self._num = {s: 0 for s in self.SOURCES}
+        self._coef: Dict[str, Optional[np.ndarray]] = {
+            s: None for s in self.SOURCES}
+
+    def _analytic_ms(self, nbytes: int, source: str) -> float:
+        gbps = self.host_gbps if source == "host" else self.peer_gbps
+        # bytes -> ms over a gigabit/s link: nbytes * 8 / (gbps * 1e9) s.
+        return self.setup_ms + float(nbytes) * 8e-6 / max(gbps, 1e-6)
+
+    def observe(self, source: str, nbytes: int, seconds: float) -> None:
+        """One completed transfer: ``nbytes`` moved in ``seconds``."""
+        if source not in self._xtx:
+            source = "peer"
+        x = np.asarray([1.0, float(nbytes)])
+        self._xtx[source] += np.outer(x, x)
+        self._xty[source] += x * (float(seconds) * 1e3)
+        self._num[source] += 1
+        self._coef[source] = None    # re-solved lazily on next predict
+
+    def trained(self, source: str) -> bool:
+        return self._num.get(source, 0) >= self.min_samples
+
+    def restore_ms(self, nbytes: int, source: str = "peer") -> float:
+        """Predicted wall-clock (ms) to restore ``nbytes`` from a link
+        class; the analytic prior until that link is calibrated."""
+        if nbytes <= 0:
+            return 0.0
+        if source not in self._xtx:
+            source = "peer"
+        if not self.trained(source):
+            return self._analytic_ms(nbytes, source)
+        if self._coef[source] is None:
+            A = self._xtx[source] + self.l2 * np.eye(2)
+            self._coef[source] = np.linalg.solve(A, self._xty[source])
+        x = np.asarray([1.0, float(nbytes)])
+        return float(max(0.0, self._coef[source] @ x))
+
+    # ---------- JSON wire format (sidecar sync) ----------
+
+    def to_dict(self) -> Dict:
+        return {
+            "peer_gbps": self.peer_gbps,
+            "host_gbps": self.host_gbps,
+            "setup_ms": self.setup_ms,
+            "xtx": {s: m.tolist() for s, m in self._xtx.items()},
+            "xty": {s: v.tolist() for s, v in self._xty.items()},
+            "num": dict(self._num),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "TransferCostModel":
+        m = cls(peer_gbps=d["peer_gbps"], host_gbps=d["host_gbps"],
+                setup_ms=d["setup_ms"])
+        for s in cls.SOURCES:
+            if s in d.get("xtx", {}):
+                m._xtx[s] = np.asarray(d["xtx"][s])
+                m._xty[s] = np.asarray(d["xty"][s])
+                m._num[s] = int(d.get("num", {}).get(s, 0))
+        return m
+
+
 class TrainingStore:
     """Capped sample buckets + retrain policy for both targets."""
 

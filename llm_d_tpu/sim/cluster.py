@@ -80,6 +80,7 @@ from llm_d_tpu.autoscaler.wva import (
 )
 from llm_d_tpu.epp.config import parse_config
 from llm_d_tpu.epp.datastore import Datastore, EndpointBreaker, EndpointState
+from llm_d_tpu.epp.indexer import PrefixIndex
 from llm_d_tpu.epp.plugins import RequestCtx
 from llm_d_tpu.epp.scheduler import EppScheduler
 from llm_d_tpu.epp.service import FlowControl
@@ -411,26 +412,31 @@ class ClusterFaultPlane:
             for r in c.in_zone(ev.target):
                 r.kill()
                 c.dead_log.add(r.address)
+                c._kv_on_kill(r.address)
         elif ev.kind == "zone_restore":
             delay = float(ev.params.get("restart_delay_s", 5.0))
             for r in c.in_zone(ev.target):
                 if not r.alive:
                     r.restore(delay)
+                    c._kv_attach(r)
                     c.track(c.spawn_boot(r))
         elif ev.kind == "flap":
             for r in c.in_zone(ev.target):
                 r.kill()
                 c.dead_log.add(r.address)
+                c._kv_on_kill(r.address)
             self._schedule_restore(ev, float(ev.params.get("down_s", 30.0)))
         elif ev.kind == "replica_kill":
             r = c.replicas.get(ev.target)
             if r is not None:
                 r.kill()
                 c.dead_log.add(r.address)
+                c._kv_on_kill(r.address)
         elif ev.kind == "replica_restore":
             r = c.replicas.get(ev.target)
             if r is not None and not r.alive:
                 r.restore(float(ev.params.get("restart_delay_s", 5.0)))
+                c._kv_attach(r)
                 c.track(c.spawn_boot(r))
         elif ev.kind == "partition":
             sel = ev.target.split("|", 1)
@@ -636,6 +642,13 @@ class Scenario:
     slos: Dict[str, SloTarget] = dataclasses.field(
         default_factory=lambda: dict(DEFAULT_SLOS))
     pd_threshold: Optional[int] = None  # tokens; None = no PD disagg
+    # Transfer-cost-aware KV placement: route with the kv-placement-scorer
+    # over a gateway-side PrefixIndex fed by in-process replica KV events,
+    # and charge modeled peer/host restore time instead of recompute.
+    # False keeps the classic weighted prefix-affinity profile — the
+    # identical-seed control arm.
+    kv_placement: bool = False
+    kv_bytes_per_token: int = 131072    # bytes of KV per token (all layers)
     scrape_interval_s: float = 1.0
     fault_tick_s: float = 1.0
     max_inflight: int = 256
@@ -686,7 +699,9 @@ def tenant_bucket(tenant: str, buckets: int) -> str:
 class _Cell:
     __slots__ = ("requests", "ok", "attained", "ttft", "tpot",
                  "deadline_miss", "stream_breaks", "resumes", "shed",
-                 "rejected", "no_endpoint", "prefill_fallback")
+                 "rejected", "no_endpoint", "prefill_fallback",
+                 "cached_tokens", "prompt_tokens", "kv_verdicts",
+                 "restore_bytes")
 
     def __init__(self) -> None:
         self.requests = 0
@@ -701,6 +716,13 @@ class _Cell:
         self.rejected = 0
         self.no_endpoint = 0
         self.prefill_fallback = 0
+        # KV placement accounting (PR 20): gateway-side per-ticket prefix
+        # hits (replica counters reset on kill/restore), placement
+        # verdicts, and modeled restore traffic.
+        self.cached_tokens = 0
+        self.prompt_tokens = 0
+        self.kv_verdicts: Dict[str, int] = {}
+        self.restore_bytes = 0
 
 
 class Scoreboard:
@@ -749,6 +771,11 @@ class Scoreboard:
             c.resumes[out] = c.resumes.get(out, 0) + n
         if rec.get("prefill_fallback"):
             c.prefill_fallback += 1
+        c.cached_tokens += int(rec.get("cached_tokens") or 0)
+        c.prompt_tokens += int(rec.get("prompt_tokens") or 0)
+        for v, n in (rec.get("kv_verdicts") or {}).items():
+            c.kv_verdicts[v] = c.kv_verdicts.get(v, 0) + n
+        c.restore_bytes += int(rec.get("restore_bytes") or 0)
         if outcome == "deadline":
             c.deadline_miss += 1
             return
@@ -783,6 +810,11 @@ class Scoreboard:
                 "rejected": c.rejected,
                 "no_endpoint": c.no_endpoint,
                 "prefill_fallback": c.prefill_fallback,
+                "prefix_hit_rate": round(
+                    c.cached_tokens / c.prompt_tokens, 6)
+                if c.prompt_tokens else 0.0,
+                "kv_verdicts": dict(sorted(c.kv_verdicts.items())),
+                "restore_bytes": c.restore_bytes,
             }
             admitted = c.requests - c.shed - c.rejected
             attained = c.attained
@@ -799,6 +831,11 @@ class Scoreboard:
             agg.shed += c.shed
             agg.rejected += c.rejected
             agg.no_endpoint += c.no_endpoint
+            agg.cached_tokens += c.cached_tokens
+            agg.prompt_tokens += c.prompt_tokens
+            for v, n in c.kv_verdicts.items():
+                agg.kv_verdicts[v] = agg.kv_verdicts.get(v, 0) + n
+            agg.restore_bytes += c.restore_bytes
             bkt = tenant_bucket(tenant, self.buckets)
             acc = bucket_acc.setdefault((crit, bkt), [0, 0])
             acc[0] += attained
@@ -817,6 +854,11 @@ class Scoreboard:
                 "shed": agg.shed,
                 "rejected": agg.rejected,
                 "no_endpoint": agg.no_endpoint,
+                "prefix_hit_rate": round(
+                    agg.cached_tokens / agg.prompt_tokens, 6)
+                if agg.prompt_tokens else 0.0,
+                "kv_verdicts": dict(sorted(agg.kv_verdicts.items())),
+                "restore_bytes": agg.restore_bytes,
             }
         attainment: Dict[str, Dict[str, float]] = {}
         for (crit, bkt), (att, adm) in sorted(bucket_acc.items()):
@@ -892,6 +934,11 @@ class SimGateway:
         prompt_ids = (list(ctx.token_ids) if ctx.token_ids
                       else (sim0.sim._tokenize(ctx.prompt_text)
                             if sim0 is not None else []))
+        if self.cluster.prefix_index is not None and not ctx.token_ids:
+            # kv-placement scoring hashes ctx.token_ids with the SAME
+            # chain the replicas publish (hash_token_blocks over the sim
+            # tokenizer's ids), so index lookups match replica caches.
+            ctx.token_ids = prompt_ids
         max_tokens = int(ctx.body.get("max_tokens", 16))
         policy = resume_policy()
         excluded: set = set()
@@ -906,6 +953,12 @@ class SimGateway:
             ctx.excluded_endpoints = set(excluded)
             ctx.retry_attempt = resumes
             result = self.scheduler.schedule(ctx)
+            # Consume this attempt's placement plan (on_picked stamps it
+            # for the picked endpoint); a retry re-schedules and gets a
+            # fresh one, so a stale plan can never charge a transfer
+            # against the wrong replica.
+            kv_plan = getattr(ctx, "kv_restore_plan", None)
+            ctx.kv_restore_plan = None
             primary = result.primary
             if primary is None:
                 rec["outcome"] = "break" if offset else "no_endpoint"
@@ -945,6 +998,27 @@ class SimGateway:
                 resumes += 1
                 self.metrics.gateway_retries.labels(reason="connect").inc()
                 continue
+            if kv_plan is not None:
+                v = kv_plan.get("verdict", "recompute")
+                verdicts = rec.setdefault("kv_verdicts", {})
+                verdicts[v] = verdicts.get(v, 0) + 1
+                if kv_plan.get("peer_blocks"):
+                    # Pull the missing prefix blocks from the plan's
+                    # source before prefill: charge the modeled link
+                    # time, then mark them resident so the replica's
+                    # own prefix-hit accounting sees them.
+                    await asyncio.sleep(
+                        float(kv_plan.get("restore_ms", 0.0)) / 1e3)
+                    sim.restore_prefix(
+                        prompt_ids, int(kv_plan.get("local_blocks", 0))
+                        + int(kv_plan["peer_blocks"]))
+                    rec["restore_bytes"] = (
+                        rec.get("restore_bytes", 0)
+                        + int(kv_plan.get("restore_bytes", 0)))
+                    span.add_event("kv.placement.restore",
+                                   source=kv_plan.get("source"),
+                                   tier=kv_plan.get("tier"),
+                                   blocks=kv_plan["peer_blocks"])
             gen = sim.stream_tokens(ticket)
             try:
                 async for i, _word in gen:
@@ -989,6 +1063,14 @@ class SimGateway:
             finally:
                 await gen.aclose()
                 if ticket is not None:
+                    # Fleet prefix-hit accounting rides the ticket, not
+                    # replica counters (kill/restore resets those).
+                    rec["cached_tokens"] = (rec.get("cached_tokens", 0)
+                                            + int(ticket.get(
+                                                "cached_tokens", 0)))
+                    rec["prompt_tokens"] = (rec.get("prompt_tokens", 0)
+                                            + int(ticket.get(
+                                                "prompt_tokens", 0)))
                     sim.release_ticket(ticket)
             # Clean finish.
             self.datastore.breaker.record_success(target)
@@ -1192,9 +1274,18 @@ class ClusterSim:
         self.datastore = SimDatastore(
             self, scrape_interval_s=scenario.scrape_interval_s,
             breaker=breaker)
+        # KV placement: the REAL gateway prefix index, fed in-process by
+        # replica KV events (virtual clock, no sockets).  None when the
+        # scenario runs the classic weighted-affinity profile.
+        self.prefix_index = (PrefixIndex(metrics=self.epp_metrics)
+                             if scenario.kv_placement else None)
+        if self.prefix_index is not None:
+            # Discovery leave / scale-down -> drop prefix ownership, the
+            # same hook the live gateway registers in build_gateway.
+            self.datastore.on_remove.append(self.prefix_index.remove_endpoint)
         self.scheduler = EppScheduler(
             parse_config(self._epp_yaml()), self.datastore,
-            metrics=self.epp_metrics)
+            metrics=self.epp_metrics, indexer=self.prefix_index)
         self.flow = FlowControl(scenario.max_inflight, scenario.max_queue,
                                 scenario.queue_timeout_s, self.epp_metrics)
         self.gateway = SimGateway(self, self.scheduler, self.datastore,
@@ -1250,7 +1341,26 @@ class ClusterSim:
                            scalable=scalable)
         self.replicas[address] = r
         self.replicas_peak = max(self.replicas_peak, len(self.replicas))
+        self._kv_attach(r)
         return r
+
+    def _kv_attach(self, r: ClusterReplica) -> None:
+        """Point the replica's KV event hook at the gateway prefix index
+        (in-process sink).  Called on add AND after every restore —
+        ``ClusterReplica.restore`` builds a fresh ``InferenceSimulator``
+        whose sink starts out None."""
+        if self.prefix_index is None:
+            return
+        r.sim.kv_event_sink = self.prefix_index.attach_inproc(
+            r.address,
+            block_nbytes=(r.sim.config.block_size
+                          * self.scenario.kv_bytes_per_token))
+
+    def _kv_on_kill(self, address: str) -> None:
+        """A dead replica's KV is gone: stale index ownership would keep
+        routing prefix-affine traffic at a pod that lost its cache."""
+        if self.prefix_index is not None:
+            self.prefix_index.remove_endpoint(address)
 
     def _remove_replica(self, address: str) -> None:
         self.replicas.pop(address, None)
@@ -1261,7 +1371,32 @@ class ClusterSim:
             [(a, r.role) for a, r in sorted(self.replicas.items())])
 
     def _epp_yaml(self) -> str:
+        kv_params = (f"{{blockSize: 64, kvBytesPerToken: "
+                     f"{int(self.scenario.kv_bytes_per_token)}}}")
         if self.scenario.pd_threshold is None:
+            if self.scenario.kv_placement:
+                # ONE unified expected-TTFT cost scorer: queue/load cost
+                # and cached-prefix benefit live on the same axis, so
+                # the benefit saturates instead of pinning (weighted
+                # prefix affinity's failure mode — docs/cluster-sim.md
+                # case study).
+                return f"""
+kind: EndpointPickerConfig
+plugins:
+- type: single-profile-handler
+- type: drain-filter
+- type: circuit-breaker-filter
+- type: kv-placement-scorer
+  parameters: {kv_params}
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: drain-filter
+  - pluginRef: circuit-breaker-filter
+  - pluginRef: kv-placement-scorer
+  - pluginRef: max-score-picker
+"""
             return """
 kind: EndpointPickerConfig
 plugins:
@@ -1284,6 +1419,33 @@ schedulingProfiles:
     weight: 2
   - pluginRef: prefix-cache-scorer
     weight: 3
+  - pluginRef: max-score-picker
+"""
+        if self.scenario.kv_placement:
+            return f"""
+kind: EndpointPickerConfig
+plugins:
+- type: pd-profile-handler
+  parameters: {{threshold: {int(self.scenario.pd_threshold)}}}
+- type: prefill-header-handler
+- type: drain-filter
+- type: circuit-breaker-filter
+- type: queue-scorer
+- type: kv-placement-scorer
+  parameters: {kv_params}
+- type: max-score-picker
+schedulingProfiles:
+- name: prefill
+  plugins:
+  - pluginRef: drain-filter
+  - pluginRef: circuit-breaker-filter
+  - pluginRef: queue-scorer
+  - pluginRef: max-score-picker
+- name: decode
+  plugins:
+  - pluginRef: drain-filter
+  - pluginRef: circuit-breaker-filter
+  - pluginRef: kv-placement-scorer
   - pluginRef: max-score-picker
 """
         return f"""

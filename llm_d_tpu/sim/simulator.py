@@ -264,6 +264,33 @@ class InferenceSimulator:
         if stored and self.kv_event_sink:
             self.kv_event_sink("BlockStored", stored)
 
+    def restore_prefix(self, token_ids: List[int], n_blocks: int) -> int:
+        """Mark the leading ``n_blocks`` prefix blocks of ``token_ids``
+        resident, as if their KV had been transferred in from a peer
+        replica or the shared host tier (the gateway's kv-placement
+        restore hop calls this AFTER charging the modeled transfer
+        time).  Restored blocks are ordinary cache entries afterwards:
+        ``_prefix_hit_tokens`` counts them and they age out by LRU like
+        locally-computed ones.  Returns the number of blocks restored."""
+        hashes = hash_token_blocks(token_ids, self.config.block_size)
+        restore = hashes[:max(0, n_blocks)]
+        if not restore:
+            return 0
+        now = time.monotonic()
+        stored = []
+        for h in restore:
+            if h not in self._cached_blocks:
+                stored.append(h)
+            self._cached_blocks[h] = now
+        while len(self._cached_blocks) > self.config.num_blocks:
+            oldest = min(self._cached_blocks, key=self._cached_blocks.get)
+            del self._cached_blocks[oldest]
+            if self.kv_event_sink:
+                self.kv_event_sink("BlockRemoved", [oldest])
+        if stored and self.kv_event_sink:
+            self.kv_event_sink("BlockStored", stored)
+        return len(restore)
+
     def spec_plan(self, prompt_ids: List[int], start: int,
                   max_tokens: int) -> List[int]:
         """Seeded acceptance model: per-step emitted-chunk sizes for a
@@ -493,6 +520,11 @@ class InferenceSimulator:
             if cached:
                 self.metrics.prefix_cache_hits.inc(
                     min(cached, len(prompt_ids)))
+            # Gateway-side accounting: replica counters reset on
+            # kill/restore, so the fleet-level scoreboard reads the
+            # per-request hit off the ticket instead of scraping.
+            ticket["cached_tokens"] = min(cached, len(prompt_ids))
+            ticket["prompt_tokens"] = len(prompt_ids)
             # TTFT scales down with prefix-cache hits (the signal the
             # prefix scorers exploit).
             miss_frac = 1.0 - min(cached, len(prompt_ids)) / max(

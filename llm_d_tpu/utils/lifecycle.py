@@ -41,6 +41,13 @@ drift apart:
   x-prefiller-host-port  EPP -> sidecar prefill hint: comma-RANKED
                          ``host:port`` list (winner first, failover
                          alternates after).
+  x-llmd-kv-placement    response marker: the kv-placement-scorer's
+                         verdict for the picked endpoint — ``local_hit``
+                         (prefix already resident), ``peer_restore``
+                         (missing blocks priced cheaper to pull from a
+                         peer/host tier than recompute), ``recompute``.
+                         Echoed to the client so load campaigns report
+                         the same placement mix as the sim scoreboard.
   x-llmd-prefill-fallback  response marker: every prefiller failed and
                          the decode pod recomputed the prefill locally.
   x-llmd-resume-offset   request header on a mid-stream RESUME forward:
@@ -94,6 +101,7 @@ SCHED_DEPTH_HEADER = "x-llmd-sched-depth"
 RETRY_ATTEMPT_HEADER = "x-llmd-retry-attempt"
 RETRY_BUDGET_HEADER = "x-llmd-retry-budget"
 PREFILLER_HEADER = "x-prefiller-host-port"
+KV_PLACEMENT_HEADER = "x-llmd-kv-placement"
 PREFILL_FALLBACK_HEADER = "x-llmd-prefill-fallback"
 RESUME_OFFSET_HEADER = "x-llmd-resume-offset"
 RESUME_ATTEMPT_HEADER = "x-llmd-resume-attempt"

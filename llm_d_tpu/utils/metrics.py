@@ -96,6 +96,16 @@ EPLB_MIGRATION_STALL_METRIC = "llmd_tpu:eplb_migration_stall_seconds"
 # tenants must not become thousands of label values.
 SLO_ATTAINMENT_METRIC = "llmd_tpu:slo_attainment_ratio"
 CLUSTER_SIM_REPLICAS_METRIC = "llmd_tpu:cluster_sim_replicas"
+# Global prefix-cache fabric (round 20): KV block events ingested by the
+# EPP's precise prefix index (ZMQ or inproc, by event type), and the
+# kv-placement-scorer's per-pick verdict — local_hit (winner already held
+# the prefix), peer_restore (cheaper to pull the missing blocks from a
+# peer/host tier than recompute), recompute (no restorable coverage
+# worth the wire bytes).  A recompute-dominated mix on prefix-heavy
+# traffic means the index is cold or the transfer model prices links as
+# slower than prefill.
+KV_EVENTS_METRIC = "llmd_tpu:kv_events_total"
+KV_PLACEMENT_DECISION_METRIC = "llmd_tpu:kv_placement_decision_total"
 
 # Buckets mirroring vLLM's TTFT / TPOT histograms (seconds).
 _TIME_BUCKETS = (
@@ -337,6 +347,18 @@ class EppMetrics:
         self.prefix_indexer_hit_ratio = Gauge(
             "inference_extension_prefix_indexer_hit_ratio",
             "Prefix indexer hit ratio over recent requests.", registry=self.registry)
+        # Global prefix-cache fabric (round 20): indexer ingest volume and
+        # the kv-placement-scorer's per-pick restore-vs-recompute verdict.
+        self.kv_events = Counter(
+            KV_EVENTS_METRIC,
+            "KV block events ingested by the prefix index, by type "
+            "(BlockStored | BlockRemoved | AllBlocksCleared).",
+            ["type"], registry=self.registry)
+        self.kv_placement_decisions = Counter(
+            KV_PLACEMENT_DECISION_METRIC,
+            "kv-placement-scorer verdicts on picked endpoints "
+            "(local_hit | peer_restore | recompute).",
+            ["verdict"], registry=self.registry)
         self.flow_control_queue = Gauge(
             "inference_extension_flow_control_queue_size",
             "Requests held by gateway flow control.", registry=self.registry)

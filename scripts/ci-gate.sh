@@ -79,6 +79,12 @@ python -m pytest tests/test_everything_on.py -q
 # autoscaling beating the identical-seed baseline, and the
 # byte-identical-scoreboard determinism contract).
 python -m pytest tests/test_cluster_sim.py -q
+# KV-placement contract fail-fast (round 20: transfer-cost-aware prefix
+# placement — restorable_prefix source ranking, LRU refresh-on-query,
+# TransferCostModel analytic prior + ridge fit + env knobs, cost-scorer
+# saturation un-pinning a loaded full-match replica, verdict header +
+# metrics): the global prefix-cache fabric must not silently re-pin.
+python -m pytest tests/test_kv_placement.py -q
 # Live-EPLB contract fail-fast (round 17: delta-plan migration — budget
 # and hysteresis invariants, atomic double-buffered flip with exact
 # post-flip weights, byte-identical greedy AND seeded parity across a
@@ -97,4 +103,5 @@ python -m pytest tests/ --ignore=tests/test_chaos.py \
     --ignore=tests/test_eplb.py \
     --ignore=tests/test_eplb_integration.py \
     --ignore=tests/test_cluster_sim.py \
+    --ignore=tests/test_kv_placement.py \
     --ignore=tests/test_tracing.py

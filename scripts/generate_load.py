@@ -82,6 +82,7 @@ from llm_d_tpu.utils.lifecycle import (  # noqa: E402
     CRITICALITY_HEADER,
     DEADLINE_EXCEEDED_HEADER,
     DEADLINE_MS_HEADER,
+    KV_PLACEMENT_HEADER,
     TENANT_HEADER,
 )
 
@@ -226,6 +227,23 @@ def pick_fault(faults: dict, rng: random.Random):
     return None
 
 
+def note_kv_verdict(stats: dict, tenant: str, resp) -> None:
+    """Fold the gateway's x-llmd-kv-placement response marker into the
+    campaign stats — globally and per tenant (the tenant's prefix pool
+    is the reuse "session") — so a live-gateway run reports the same
+    local_hit / peer_restore / recompute mix as the cluster-sim
+    scoreboard's ``kv_verdicts`` field."""
+    verdict = resp.headers.get(KV_PLACEMENT_HEADER)
+    if not verdict:
+        return
+    kv = stats.setdefault("kv_verdicts", {})
+    kv[verdict] = kv.get(verdict, 0) + 1
+    if tenant:
+        tkv = stats.setdefault("per_tenant", {}).setdefault(
+            tenant, {"requests": 0}).setdefault("kv_verdicts", {})
+        tkv[verdict] = tkv.get(verdict, 0) + 1
+
+
 async def one_request(session, args, rng, stats, tenant: str = "",
                       override: dict | None = None) -> None:
     if override is not None:
@@ -295,6 +313,7 @@ async def one_request(session, args, rng, stats, tenant: str = "",
                     payload = b""
                     broke = True
                 stats[resp.status] = stats.get(resp.status, 0) + 1
+                note_kv_verdict(stats, tenant, resp)
                 if resp.status == 504 or resp.headers.get(
                         DEADLINE_EXCEEDED_HEADER):
                     cls["deadline_miss"] += 1
@@ -325,6 +344,7 @@ async def one_request(session, args, rng, stats, tenant: str = "",
                                     headers=headers, **kw) as resp:
                 await resp.read()
                 stats[resp.status] = stats.get(resp.status, 0) + 1
+                note_kv_verdict(stats, tenant, resp)
                 if resp.status == 504 or resp.headers.get(
                         DEADLINE_EXCEEDED_HEADER):
                     cls["deadline_miss"] += 1
@@ -388,6 +408,7 @@ async def run(args) -> None:
                 c["deadline_miss"] / c["requests"], 4)
             if c["requests"] else 0.0,
         }
+    kv_verdicts = stats.pop("kv_verdicts", {})
     breaks = stats.pop("stream_breaks", 0)
     cont_errors = stats.pop("continuity_errors", 0)
     n_chunks = stats.pop("token_chunks", 0)
@@ -401,7 +422,23 @@ async def run(args) -> None:
         "per_class": per_class,
     }
     if per_tenant:
+        # Per-tenant prefix-reuse rate from the placement verdicts (the
+        # tenant's prefix pool is the reuse "session"): fraction of
+        # requests the scheduler placed on ALREADY-warm KV — locally or
+        # via a peer restore — matching the sim scoreboard's
+        # kv_verdicts / prefix_hit_rate fields.
+        for t in per_tenant.values():
+            tkv = t.get("kv_verdicts")
+            if tkv:
+                total = sum(tkv.values())
+                t["prefix_reuse_rate"] = round(
+                    (total - tkv.get("recompute", 0)) / total, 4)
         summary["per_tenant"] = per_tenant
+    if kv_verdicts:
+        total = sum(kv_verdicts.values())
+        summary["kv_verdicts"] = dict(sorted(kv_verdicts.items()))
+        summary["prefix_reuse_rate"] = round(
+            (total - kv_verdicts.get("recompute", 0)) / total, 4)
     if args.trace_out is not None:
         summary["trace_out"] = {"path": args.trace_out,
                                 "records": len(trace_records)}

@@ -339,6 +339,80 @@ def test_wva_closed_loop_scales_up_on_burst_and_down_at_trough():
 
 
 # ---------------------------------------------------------------------------
+# Transfer-cost-aware KV placement (fast tier)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_placement_unpins_fully_cached_traffic():
+    # Re-seeds the docs/cluster-sim.md pinning case study: with 2 prefix
+    # pools every prompt is a full cache hit on a pinned replica, and
+    # the weight-3 prefix scorer outbids the weight-2 queue scorer by
+    # the margin of a full match — fresh autoscaled replicas never win a
+    # pick, so scale-up barely moves the needle.  The kv-placement cost
+    # scorer prices the SAME cache hit as avoided-prefill milliseconds,
+    # which saturates against unbounded queue cost: identical seed,
+    # identical autoscaling, and the tail collapses.
+    def scenario(kv):
+        return {
+            "name": "unpin", "seed": 43, "duration_s": 60.0,
+            "replicas": [{"zone": "zone-a", "count": 2,
+                          "max_num_seqs": 4}],
+            "tenants": [{"name": "acme", "qps": 40.0,
+                         "prefix_groups": 2, "prefix_len": 100,
+                         "criticality": "critical", "max_tokens": 24}],
+            "diurnal": {"period_s": 60.0, "low": 0.05, "high": 1.0},
+            "autoscale": {"enabled": True, "min_replicas": 2,
+                          "max_replicas": 12, "target_saturation": 0.6,
+                          "interval_s": 5.0, "zone": "zone-a",
+                          "startup_delay_s": 2.0},
+            "scrape_interval_s": 1.0,
+            "kv_placement": kv,
+        }
+
+    _, base = _run(scenario(False))
+    _, rep = _run(scenario(True))
+    cell = rep["tenants"]["acme"]["critical"]
+    base_cell = base["tenants"]["acme"]["critical"]
+    # Both arms: fully-cached traffic, zero breaks, nothing dropped.
+    for c in (cell, base_cell):
+        assert c["stream_breaks"] == 0
+        assert c["ok"] == c["requests"]
+        assert c["prefix_hit_rate"] > 0.8
+    # Weight-3 stays pinned (failing attainment despite the autoscaler);
+    # the cost scorer un-pins: tail collapses, attainment recovers, and
+    # the prefix-hit rate does NOT pay for it — missing blocks are
+    # restored from peers instead of recomputed cold.
+    assert cell["ttft_p99_ms"] < base_cell["ttft_p99_ms"] * 0.8
+    assert cell["attainment"] > base_cell["attainment"] + 0.1
+    assert cell["attainment"] > 0.95
+    assert cell["prefix_hit_rate"] >= base_cell["prefix_hit_rate"] - 0.01
+    verdicts = cell["kv_verdicts"]
+    assert verdicts.get("local_hit", 0) > 0.9 * cell["requests"]
+    assert base_cell["kv_verdicts"] == {}      # control arm has no scorer
+
+
+def test_kv_placement_report_is_byte_identical():
+    d = {
+        "name": "kv-det", "seed": 47, "duration_s": 20.0,
+        "replicas": [{"zone": "zone-a", "count": 4, "max_num_seqs": 2}],
+        "tenants": [{"name": "acme", "qps": 8.0, "prefix_groups": 3,
+                     "prefix_len": 60, "max_tokens": 12}],
+        "faults": [{"at_s": 8.0, "kind": "replica_kill",
+                    "target": "zone-a-0:8200"},
+                   {"at_s": 14.0, "kind": "replica_restore",
+                    "target": "zone-a-0:8200"}],
+        "kv_placement": True,
+    }
+    j1 = ClusterSim(Scenario.from_dict(d)).run_json()
+    j2 = ClusterSim(Scenario.from_dict(d)).run_json()
+    assert j1 == j2
+    cls = json.loads(j1)["classes"]["standard"]
+    # The fabric actually moved bytes: kill/restore forces peer restores.
+    assert cls["kv_verdicts"].get("peer_restore", 0) > 0
+    assert cls["restore_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
 # Acceptance scenario (slow tier)
 # ---------------------------------------------------------------------------
 
@@ -423,5 +497,85 @@ def test_acceptance_100_replica_incident_scoreboard():
 
     # Same seed, byte-identical scoreboard.
     rep2 = ClusterSim(Scenario.from_dict(d)).run()
+    assert json.dumps(rep, sort_keys=True) == \
+        json.dumps(rep2, sort_keys=True)
+
+
+@pytest.mark.slow
+def test_acceptance_kv_placement_beats_weight3_at_100_replicas():
+    """PR 20 acceptance gate: a seeded multi-turn agent trace on a
+    104-replica fleet under the round-18 chaos fault timeline (zone
+    kill + P↔D partition + stragglers, diurnal load).  The kv-placement
+    cost scorer must beat the identical-seed weight-3 baseline on
+    steady-state prefix-hit rate AND p99 TTFT / attainment, with zero
+    critical stream breaks, and the report must be byte-identical
+    across two same-seed runs."""
+    def scenario(kv):
+        return {
+            "name": "kv-fabric", "seed": 1013, "duration_s": 120.0,
+            "pd_threshold": 64,
+            "replicas": [
+                {"zone": "zone-a", "count": 48, "role": "decode",
+                 "max_num_seqs": 4},
+                {"zone": "zone-b", "count": 48, "role": "decode",
+                 "max_num_seqs": 4},
+                {"zone": "zone-p", "count": 8, "role": "prefill"},
+            ],
+            "tenants": [
+                {"name": "acme", "qps": 30.0, "criticality": "critical",
+                 "max_tokens": 60, "prefix_groups": 24,
+                 "prefix_len": 100, "deadline_ms": 30000},
+                {"name": "agents", "qps": 6.0, "kind": "agent",
+                 "turns": 3, "prefix_groups": 12, "prefix_len": 100,
+                 "criticality": "standard", "max_tokens": 16},
+            ],
+            "diurnal": {"period_s": 120.0, "low": 0.3, "high": 1.0},
+            "faults": [
+                {"at_s": 30.0, "kind": "zone_kill", "target": "zone-b"},
+                {"at_s": 50.0, "kind": "partition",
+                 "target": "role:decode|role:prefill"},
+                {"at_s": 80.0, "kind": "partition_heal",
+                 "target": "role:decode|role:prefill"},
+                {"at_s": 60.0, "kind": "straggler",
+                 "target": "zone-a-0:8200", "factor": 5.0},
+                {"at_s": 60.0, "kind": "straggler",
+                 "target": "zone-a-1:8200", "factor": 5.0},
+            ],
+            "breaker_failures": 1,
+            "scrape_interval_s": 1.0,
+            "max_inflight": 1024, "max_queue": 2048,
+            "kv_placement": kv,
+        }
+
+    base = ClusterSim(Scenario.from_dict(scenario(False))).run()
+    rep = ClusterSim(Scenario.from_dict(scenario(True))).run()
+    assert rep["fleet"]["replicas_peak"] >= 100
+
+    acme = rep["tenants"]["acme"]["critical"]
+    base_acme = base["tenants"]["acme"]["critical"]
+    # Zero critical stream breaks through the whole incident, both arms.
+    assert acme["stream_breaks"] == 0
+    assert base_acme["stream_breaks"] == 0
+    assert acme["requests"] == base_acme["requests"] > 2000
+
+    # The cost scorer beats weight-3 on BOTH axes: steady-state
+    # prefix-hit rate no worse, and the half-fleet-down queueing tail
+    # (weight-3 keeps routing at pinned-but-drowning survivors)
+    # collapses by an order of magnitude.
+    assert acme["prefix_hit_rate"] >= base_acme["prefix_hit_rate"]
+    assert acme["ttft_p99_ms"] < base_acme["ttft_p99_ms"] / 2
+    assert acme["attainment"] > base_acme["attainment"]
+    assert acme["attainment"] > 0.99
+
+    # Placement verdicts cover the tenant's admitted traffic and the
+    # multi-turn agent tenant kept its session affinity benefit.
+    assert sum(acme["kv_verdicts"].values()) >= acme["requests"]
+    agents = rep["tenants"]["agents"]["standard"]
+    assert agents["prefix_hit_rate"] >= \
+        base["tenants"]["agents"]["standard"]["prefix_hit_rate"]
+
+    # Same seed, byte-identical report (restore sleeps, verdict counts
+    # and transfer-byte accounting included).
+    rep2 = ClusterSim(Scenario.from_dict(scenario(True))).run()
     assert json.dumps(rep, sort_keys=True) == \
         json.dumps(rep2, sort_keys=True)

@@ -1,0 +1,288 @@
+"""Transfer-cost-aware KV placement (PR 20).
+
+Unit tier for the pieces behind the kv-placement-scorer: the byte/tier-
+aware PrefixIndex extensions (``restorable_prefix``, ``attach_inproc``,
+the query-refreshes-LRU fix), the link-cost TransferCostModel, the new
+``llmd_tpu:kv_events_total`` / ``llmd_tpu:kv_placement_decision_total``
+counters, and the scorer's saturation property — cached-prefix benefit
+is bounded by avoided prefill cost while queue cost grows without
+bound, so a hot pinned replica LOSES to an idle peer-restore candidate
+(the un-pinning the docs/cluster-sim.md case study asks for).
+"""
+
+import pytest
+
+from llm_d_tpu.epp.datastore import Datastore, EndpointState
+from llm_d_tpu.epp.indexer import (
+    DEVICE_TIER,
+    HOST_TIER,
+    PrefixIndex,
+    RestorePlan,
+)
+from llm_d_tpu.epp.plugins import KvPlacementScorer, RequestCtx
+from llm_d_tpu.predictor.model import TransferCostModel
+from llm_d_tpu.utils.hashing import hash_token_blocks
+from llm_d_tpu.utils.metrics import EppMetrics
+
+
+K = [bytes([i]) * 8 for i in range(16)]      # opaque block hashes
+
+
+# ---------------------------------------------------------------------------
+# PrefixIndex: bytes/tier tracking + restorable_prefix
+# ---------------------------------------------------------------------------
+
+
+def test_restorable_prefix_local_then_peer():
+    idx = PrefixIndex()
+    # Candidate A holds blocks 0-1; peer B holds 0-3 (so B can restore
+    # the contiguous continuation 2-3 to A).
+    idx.on_event("A", "BlockStored", K[0:2], nbytes=1024)
+    idx.on_event("B", "BlockStored", K[0:4], nbytes=2048)
+    plan = idx.restorable_prefix(K[0:4], "A")
+    assert plan.local_blocks == 2
+    assert plan.peer_blocks == 2
+    assert plan.source == "B"
+    assert plan.tier == DEVICE_TIER
+    assert plan.nbytes == 2 * 2048
+    assert plan.total_blocks == 4
+
+
+def test_restorable_prefix_prefers_longest_then_device_tier():
+    idx = PrefixIndex()
+    # host tier covers 3 continuation blocks, device peer only 2:
+    # longest contiguous run wins even at host tier...
+    idx.on_event("host-pool", "BlockStored", K[0:3],
+                 nbytes=4096, tier=HOST_TIER)
+    idx.on_event("B", "BlockStored", K[0:2], nbytes=4096)
+    plan = idx.restorable_prefix(K[0:3], "A")
+    assert (plan.source, plan.peer_blocks) == ("host-pool", 3)
+    assert plan.tier == HOST_TIER
+    # ...but on equal length the device-tier source is preferred.
+    idx.on_event("B", "BlockStored", [K[2]], nbytes=4096)
+    plan = idx.restorable_prefix(K[0:3], "A")
+    assert (plan.source, plan.tier) == ("B", DEVICE_TIER)
+
+
+def test_restorable_prefix_stops_at_gap_and_excludes_self():
+    idx = PrefixIndex()
+    idx.on_event("A", "BlockStored", [K[0]])
+    idx.on_event("B", "BlockStored", [K[1]])      # K[2] nowhere -> gap
+    idx.on_event("B", "BlockStored", [K[3]])
+    plan = idx.restorable_prefix(K[0:4], "A")
+    assert (plan.local_blocks, plan.peer_blocks) == (1, 1)
+    # A block only the candidate itself holds is NOT peer-restorable.
+    solo = PrefixIndex()
+    solo.on_event("A", "BlockStored", K[0:2])
+    plan = solo.restorable_prefix(K[0:2], "A")
+    assert plan.local_blocks == 2 and plan.peer_blocks == 0
+    assert plan.source is None
+    empty = solo.restorable_prefix(K[4:6], "A")
+    assert empty.total_blocks == 0 and empty.nbytes == 0
+
+
+def test_attach_inproc_routes_events_with_bytes_and_removal():
+    idx = PrefixIndex()
+    sink = idx.attach_inproc("sim-a:8200", block_nbytes=8192)
+    sink("BlockStored", K[0:2])
+    plan = idx.restorable_prefix(K[0:2], "other")
+    assert plan.peer_blocks == 2 and plan.nbytes == 2 * 8192
+    sink("BlockRemoved", [K[1]])
+    assert idx.restorable_prefix(K[0:2], "other").peer_blocks == 1
+    idx.remove_endpoint("sim-a:8200")
+    assert idx.size == 0
+
+
+def test_query_hit_refreshes_lru_recency():
+    # The longest_prefix LRU bugfix: a block queried on every schedule
+    # but never re-stored must NOT be the first eviction victim.
+    idx = PrefixIndex(capacity=4)
+    idx.on_event("A", "BlockStored", K[0:4])
+    for fresh in K[4:10]:
+        assert idx.longest_prefix([K[0]], "A") == 1   # touch the hot block
+        idx.on_event("A", "BlockStored", [fresh])     # churn past capacity
+    assert idx.longest_prefix([K[0]], "A") == 1, \
+        "repeatedly-queried block evicted by capacity churn"
+    # Control: an un-queried sibling from the same store DID age out.
+    assert idx.longest_prefix([K[1]], "A") == 0
+
+
+def test_kv_event_metrics_count_by_type():
+    m = EppMetrics()
+    idx = PrefixIndex(metrics=m)
+    idx.on_event("A", "BlockStored", K[0:3])
+    idx.on_event("A", "BlockRemoved", [K[0]])
+    idx.remove_endpoint("A")
+
+    def count(event_type):
+        return m.registry.get_sample_value(
+            "llmd_tpu:kv_events_total", {"type": event_type})
+
+    assert count("BlockStored") == 3
+    assert count("BlockRemoved") == 1
+    assert count("AllBlocksCleared") == 1
+
+
+# ---------------------------------------------------------------------------
+# TransferCostModel
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_cost_analytic_scales_with_bytes_and_link():
+    tm = TransferCostModel(peer_gbps=16.0, host_gbps=64.0, setup_ms=2.0)
+    assert tm.restore_ms(0) == 0.0
+    one_gb = 10 ** 9
+    # 1 GB at 16 Gb/s = 500 ms + setup; the host link is 4x faster.
+    assert tm.restore_ms(one_gb, "peer") == pytest.approx(502.0, rel=0.01)
+    assert tm.restore_ms(one_gb, "host") == pytest.approx(127.0, rel=0.01)
+    assert tm.restore_ms(2 * one_gb, "peer") > tm.restore_ms(one_gb, "peer")
+
+
+def test_transfer_cost_fit_overrides_analytic_prior():
+    tm = TransferCostModel(peer_gbps=16.0, setup_ms=2.0, min_samples=8)
+    # The observed link is 10x slower than the configured prior.
+    for i in range(1, 12):
+        nbytes = i * 10 ** 7
+        tm.observe("peer", nbytes, (2.0 + nbytes * 8e-6 * 10 / 16.0) / 1e3)
+    assert tm.trained("peer")
+    fitted = tm.restore_ms(10 ** 8, "peer")
+    analytic = TransferCostModel(
+        peer_gbps=16.0, setup_ms=2.0).restore_ms(10 ** 8, "peer")
+    assert fitted == pytest.approx(10 * (analytic - 2.0) + 2.0, rel=0.05)
+
+
+def test_transfer_cost_roundtrips_through_dict():
+    tm = TransferCostModel(peer_gbps=8.0, host_gbps=32.0, setup_ms=1.0)
+    for i in range(1, 10):
+        tm.observe("host", i * 10 ** 6, 0.001 * i)
+    clone = TransferCostModel.from_dict(tm.to_dict())
+    assert clone.restore_ms(5 * 10 ** 6, "host") == \
+        pytest.approx(tm.restore_ms(5 * 10 ** 6, "host"))
+    assert clone.restore_ms(5 * 10 ** 6, "peer") == \
+        pytest.approx(tm.restore_ms(5 * 10 ** 6, "peer"))
+
+
+def test_transfer_cost_env_knobs(monkeypatch):
+    monkeypatch.setenv("LLMD_KV_TRANSFER_PEER_GBPS", "1.0")
+    monkeypatch.setenv("LLMD_KV_TRANSFER_SETUP_MS", "0.0")
+    tm = TransferCostModel()
+    # 10^9 bytes * 8 bits / 1 Gb/s = 8000 ms, no setup.
+    assert tm.restore_ms(10 ** 9, "peer") == pytest.approx(8000.0, rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# KvPlacementScorer: cost model + saturation + verdicts
+# ---------------------------------------------------------------------------
+
+
+BLOCK = 64
+
+
+def _scorer(indexer, metrics=None, **params):
+    eps = [EndpointState(address="10.0.0.1:8200", ready=True),
+           EndpointState(address="10.0.0.2:8200", ready=True)]
+    ds = Datastore(eps)
+    p = dict({"blockSize": BLOCK, "kvBytesPerToken": 131072}, **params)
+    return (KvPlacementScorer("kv-placement-scorer", p, ds,
+                              indexer=indexer, metrics=metrics), eps)
+
+
+def _ctx(n_tokens=4 * BLOCK):
+    return RequestCtx(body={}, prompt_text="x" * (4 * n_tokens),
+                      token_ids=list(range(n_tokens)))
+
+
+def test_scorer_saturates_hot_pinned_replica_loses_to_idle_peer():
+    # The pinning pathology, un-pinned by construction: the replica
+    # holding the whole prefix is deeply queued; an idle peer can
+    # restore the same prefix for a bounded transfer cost.  Expected
+    # TTFT must rank the idle peer first no matter how large the queue
+    # grows — cached benefit saturates, queue cost does not.
+    idx = PrefixIndex()
+    ctx = _ctx()
+    keys = hash_token_blocks(ctx.token_ids, BLOCK)
+    scorer, eps = _scorer(idx)
+    idx.on_event(eps[0].address, "BlockStored", keys, nbytes=BLOCK * 131072)
+    eps[0].num_waiting = 40            # pinned AND drowning
+    eps[1].num_waiting = 0             # idle, cold
+    scores = scorer.score(ctx, eps)
+    assert scores[eps[1].address] > scores[eps[0].address]
+    plans = ctx._kv_plan_map
+    assert plans[eps[0].address]["verdict"] == "local_hit"
+    assert plans[eps[1].address]["verdict"] == "peer_restore"
+    assert plans[eps[1].address]["source"] == eps[0].address
+    assert plans[eps[1].address]["restore_bytes"] == \
+        len(keys) * BLOCK * 131072
+
+
+def test_scorer_prefers_cached_replica_at_equal_load():
+    idx = PrefixIndex()
+    ctx = _ctx()
+    keys = hash_token_blocks(ctx.token_ids, BLOCK)
+    scorer, eps = _scorer(idx)
+    idx.on_event(eps[0].address, "BlockStored", keys, nbytes=BLOCK * 131072)
+    scores = scorer.score(ctx, eps)     # both idle
+    assert scores[eps[0].address] > scores[eps[1].address]
+
+
+def test_scorer_on_picked_stamps_header_plan_and_metric():
+    from llm_d_tpu.utils.lifecycle import KV_PLACEMENT_HEADER
+
+    m = EppMetrics()
+    idx = PrefixIndex(metrics=m)
+    ctx = _ctx()
+    keys = hash_token_blocks(ctx.token_ids, BLOCK)
+    scorer, eps = _scorer(idx, metrics=m)
+    idx.on_event(eps[0].address, "BlockStored", keys, nbytes=BLOCK * 131072)
+    scorer.score(ctx, eps)
+    scorer.on_picked(ctx, eps[1], "default")
+    assert ctx.headers[KV_PLACEMENT_HEADER] == "peer_restore"
+    assert ctx.kv_restore_plan["peer_blocks"] == len(keys)
+    assert ctx.kv_restore_plan["restore_ms"] > 0
+    assert m.registry.get_sample_value(
+        "llmd_tpu:kv_placement_decision_total",
+        {"verdict": "peer_restore"}) == 1
+
+
+def test_scorer_recompute_without_index_coverage():
+    idx = PrefixIndex()
+    ctx = _ctx()
+    scorer, eps = _scorer(idx)
+    scores = scorer.score(ctx, eps)
+    assert set(scores) == {e.address for e in eps}
+    assert all(v == 1.0 for v in scores.values())   # equal cost -> minmax 1.0
+    assert all(p["verdict"] == "recompute"
+               for p in ctx._kv_plan_map.values())
+
+
+def test_scheduler_wires_kv_placement_scorer():
+    from llm_d_tpu.epp.config import parse_config
+    from llm_d_tpu.epp.scheduler import EppScheduler
+
+    yaml = """
+kind: EndpointPickerConfig
+plugins:
+- type: single-profile-handler
+- type: kv-placement-scorer
+  parameters: {blockSize: 64}
+- type: max-score-picker
+schedulingProfiles:
+- name: default
+  plugins:
+  - pluginRef: kv-placement-scorer
+  - pluginRef: max-score-picker
+"""
+    idx = PrefixIndex()
+    eps = [EndpointState(address="10.0.0.1:8200", ready=True)]
+    sched = EppScheduler(parse_config(yaml), Datastore(eps), indexer=idx)
+    scorer = sched.plugins["kv-placement-scorer"]
+    assert isinstance(scorer, KvPlacementScorer)
+    assert scorer.indexer is idx
+    result = sched.schedule(_ctx())
+    assert result.primary is not None
+
+
+def test_restore_plan_dataclass_defaults():
+    plan = RestorePlan()
+    assert plan.total_blocks == 0
+    assert plan.tier == DEVICE_TIER
